@@ -1,0 +1,16 @@
+"""keto_tpu — a TPU-native Zanzibar-style authorization server.
+
+Re-implements the capabilities of Ory Keto (reference: Go server at
+/root/reference, snapshot ~v0.8.1): relation-tuple storage with namespaces,
+Check / Expand / relation-tuple read-write APIs over REST + gRPC (read :4466,
+write :4467), and a CLI.
+
+Architecture difference from the reference: instead of a per-request recursive
+DFS that issues one SQL query per subject-set indirection
+(reference internal/check/engine.go:36-114), the permission-check hot path runs
+as batched fixed-depth sparse frontier expansion over a CSR-encoded
+relation-tuple graph resident in TPU HBM (keto_tpu/ops, keto_tpu/engine),
+sharded over an ICI device mesh for graphs beyond one chip (keto_tpu/parallel).
+"""
+
+__version__ = "0.1.0"

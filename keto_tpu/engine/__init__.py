@@ -1,0 +1,12 @@
+from .check import CheckEngine, DEFAULT_MAX_DEPTH, clamp_depth
+from .expand import ExpandEngine
+from .tree import NodeType, Tree
+
+__all__ = [
+    "CheckEngine",
+    "DEFAULT_MAX_DEPTH",
+    "ExpandEngine",
+    "NodeType",
+    "Tree",
+    "clamp_depth",
+]

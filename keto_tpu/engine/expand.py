@@ -1,0 +1,73 @@
+"""Expand engine: build the full subject tree for a subject set.
+
+Mirrors reference internal/expand/engine.go:33-102:
+
+- SubjectID (or depth exhausted) -> Leaf.
+- SubjectSet -> Union node whose children are the expansions of each tuple's
+  subject; depth <= 1 degrades the node to a Leaf (engine.go:72-75).
+- A subject set already visited on the current search, or one with no tuples,
+  yields no node (``None``) (engine.go:42-45, 67-69).
+- Tuple pages are followed do-while style (engine.go:55-65).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..relationtuple.definitions import (
+    Manager,
+    RelationQuery,
+    Subject,
+    SubjectSet,
+)
+from ..utils.errors import ErrNotFound
+from ..utils.pagination import PaginationOptions
+from .check import DEFAULT_MAX_DEPTH, clamp_depth
+from .tree import NodeType, Tree
+
+
+class ExpandEngine:
+    def __init__(self, manager: Manager, max_depth: int = DEFAULT_MAX_DEPTH):
+        self.manager = manager
+        self.global_max_depth = max_depth
+
+    def build_tree(self, subject: Subject, max_depth: int = 0) -> Optional[Tree]:
+        depth = clamp_depth(max_depth, self.global_max_depth)
+        return self._build(subject, depth, visited=set())
+
+    def _build(self, subject: Subject, rest_depth: int, visited: set) -> Optional[Tree]:
+        if not isinstance(subject, SubjectSet):
+            return Tree(type=NodeType.LEAF, subject=subject)
+
+        if str(subject) in visited:
+            return None
+        visited.add(str(subject))
+
+        query = RelationQuery(
+            namespace=subject.namespace,
+            object=subject.object,
+            relation=subject.relation,
+        )
+        rels, token = [], ""
+        while True:
+            try:
+                page, token = self.manager.get_relation_tuples(
+                    query, PaginationOptions(token=token)
+                )
+            except ErrNotFound:
+                return None
+            rels.extend(page)
+            if not token:
+                break
+
+        if not rels:
+            return None
+        if rest_depth <= 1:
+            return Tree(type=NodeType.LEAF, subject=subject)
+
+        children = []
+        for r in rels:
+            child = self._build(r.subject, rest_depth - 1, visited)
+            if child is not None:
+                children.append(child)
+        return Tree(type=NodeType.UNION, subject=subject, children=children)

@@ -1,0 +1,85 @@
+"""Expand result tree (reference internal/expand/tree.go).
+
+``Tree{type, subject, children}`` with NodeType union/exclusion/intersection/
+leaf (the reference only ever produces union + leaf today — tree.go:15-30).
+JSON wire form matches the reference's swagger model ``expandTree``
+(tree.go:84-90): ``{"type", "children"?, "subject_id"? | "subject_set"?}``.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Mapping, Optional
+
+from ..relationtuple.definitions import (
+    Subject,
+    SubjectID,
+    subject_from_dict,
+)
+from ..utils.errors import ErrMalformedInput
+
+
+class NodeType(str, enum.Enum):
+    UNION = "union"
+    EXCLUSION = "exclusion"
+    INTERSECTION = "intersection"
+    LEAF = "leaf"
+
+    def __str__(self) -> str:  # json value
+        return self.value
+
+
+@dataclass
+class Tree:
+    type: NodeType
+    subject: Subject
+    children: list["Tree"] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        # wire form: subject_id XOR subject_set (reference tree.go:84-90)
+        n: dict = {"type": self.type.value}
+        if isinstance(self.subject, SubjectID):
+            n["subject_id"] = self.subject.id
+        else:
+            n["subject_set"] = self.subject.to_dict()
+        if self.children:
+            n["children"] = [c.to_dict() for c in self.children]
+        return n
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Tree":
+        try:
+            node_type = NodeType(d["type"])
+        except (KeyError, ValueError) as e:
+            raise ErrMalformedInput(f"unknown node type: {d.get('type')!r}") from e
+        if d.get("subject_id") is not None and d.get("subject_set") is not None:
+            raise ErrMalformedInput("subject_id and subject_set are mutually exclusive")
+        if d.get("subject_id") is not None:
+            subject: Subject = SubjectID(id=d["subject_id"])
+        elif d.get("subject_set") is not None:
+            subject = subject_from_dict(d["subject_set"])
+        else:
+            raise ErrMalformedInput("tree node without subject")
+        children = [cls.from_dict(c) for c in d.get("children") or []]
+        return cls(type=node_type, subject=subject, children=children)
+
+    def __str__(self) -> str:
+        """Pretty printer matching the reference's CLI rendering style
+        (tree.go:218-235): leaves marked with a clover, unions with ∪."""
+        if self.type == NodeType.LEAF:
+            return f"☘ {self.subject}️"
+        children = [
+            "\n│  ".join(str(c).split("\n")) for c in self.children
+        ]
+        return f"∪ {self.subject}\n├─ " + "\n├─ ".join(children)
+
+    def flat_subjects(self) -> list[Subject]:
+        out: list[Subject] = [self.subject]
+        for c in self.children:
+            out.extend(c.flat_subjects())
+        return out
+
+
+def tree_to_optional_dict(t: Optional[Tree]) -> Optional[dict]:
+    return None if t is None else t.to_dict()

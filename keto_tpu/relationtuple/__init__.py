@@ -1,0 +1,27 @@
+from .definitions import (
+    Manager,
+    ManagerWrapper,
+    RelationQuery,
+    RelationTuple,
+    Subject,
+    SubjectID,
+    SubjectSet,
+    parse_tuples_text,
+    relation_collection_table,
+    subject_from_dict,
+    subject_from_string,
+)
+
+__all__ = [
+    "Manager",
+    "ManagerWrapper",
+    "RelationQuery",
+    "RelationTuple",
+    "Subject",
+    "SubjectID",
+    "SubjectSet",
+    "parse_tuples_text",
+    "relation_collection_table",
+    "subject_from_dict",
+    "subject_from_string",
+]

@@ -1,0 +1,3 @@
+from .memory import InMemoryTupleStore
+
+__all__ = ["InMemoryTupleStore"]

@@ -1,0 +1,171 @@
+"""In-memory relation-tuple store.
+
+Plays the role of the reference's SQL persister
+(reference internal/persistence/sql/relationtuples.go): it implements the
+``relationtuple.Manager`` contract — write/get/delete/delete-all/transact with
+opaque-token pagination, namespace validation, and network-id (tenant)
+isolation (reference persister.go:94-96 ``QueryWithNetwork``; isolation
+contract manager_isolation.go:44-138).
+
+In this architecture the store is the *write-side source of truth*; the device
+snapshot layer (keto_tpu/models) encodes its contents into CSR arrays for the
+TPU engines and subscribes to its monotonically increasing version counter —
+the honest implementation of the "snaptoken" the reference stubs out
+(reference check_service.proto:43-80 "not implemented").
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Callable, Sequence
+
+from ..namespace.definitions import NamespaceManager
+from ..relationtuple.definitions import (
+    Manager,
+    RelationQuery,
+    RelationTuple,
+)
+from ..utils.errors import ErrInvalidTuple
+from ..utils.pagination import (
+    PaginationOptions,
+    decode_page_token,
+    encode_page_token,
+)
+
+
+class InMemoryTupleStore(Manager):
+    """Insertion-ordered, deduplicated, thread-safe tuple store.
+
+    Writing an already-existing tuple is a no-op for reads (the reference's
+    SQL layer would raise a uniqueness error on exact duplicates only in some
+    dialects; its contract tests never insert duplicates — we keep idempotent
+    upsert semantics, which Zanzibar specifies).
+    """
+
+    def __init__(
+        self,
+        namespace_manager: NamespaceManager | None = None,
+        network_id: str | None = None,
+    ):
+        self._lock = threading.RLock()
+        # insertion-ordered mapping tuple -> insert sequence number
+        self._tuples: dict[RelationTuple, int] = {}
+        self._seq = 0
+        self._version = 0
+        self.namespace_manager = namespace_manager
+        self.network_id = network_id or str(uuid.uuid4())
+        self._listeners: list[Callable[[int], None]] = []
+
+    # -- version / change feed ------------------------------------------------
+
+    @property
+    def version(self) -> int:
+        """Monotonic write counter; the snapshot layer's snaptoken source."""
+        with self._lock:
+            return self._version
+
+    def subscribe(self, fn: Callable[[int], None]) -> None:
+        """Register a callback invoked (under no lock) after each mutation."""
+        self._listeners.append(fn)
+
+    def _bump(self) -> int:
+        self._version += 1
+        return self._version
+
+    def _notify(self, version: int) -> None:
+        for fn in self._listeners:
+            fn(version)
+
+    # -- validation -----------------------------------------------------------
+
+    def _validate(self, t: RelationTuple) -> None:
+        if t.subject is None:
+            raise ErrInvalidTuple("subject must not be nil")
+        if self.namespace_manager is not None:
+            # raises ErrNamespaceNotFound (404) like the reference
+            # (manager_requirements.go:58-66)
+            self.namespace_manager.get_namespace_by_name(t.namespace)
+
+    # -- Manager contract -----------------------------------------------------
+
+    def get_relation_tuples(
+        self, query: RelationQuery, pagination: PaginationOptions | None = None
+    ) -> tuple[list[RelationTuple], str]:
+        pagination = pagination or PaginationOptions()
+        offset = decode_page_token(pagination.token)
+        per_page = pagination.per_page
+        if (
+            self.namespace_manager is not None
+            and query.namespace is not None
+        ):
+            self.namespace_manager.get_namespace_by_name(query.namespace)
+        with self._lock:
+            matched = [t for t in self._tuples if query.matches(t)]
+        page = matched[offset : offset + per_page]
+        next_token = (
+            encode_page_token(offset + per_page)
+            if offset + per_page < len(matched)
+            else ""
+        )
+        return page, next_token
+
+    def write_relation_tuples(self, *tuples: RelationTuple) -> None:
+        for t in tuples:
+            self._validate(t)
+        with self._lock:
+            for t in tuples:
+                if t not in self._tuples:
+                    self._tuples[t] = self._seq
+                    self._seq += 1
+            v = self._bump()
+        self._notify(v)
+
+    def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
+        with self._lock:
+            for t in tuples:
+                self._tuples.pop(t, None)
+            v = self._bump()
+        self._notify(v)
+
+    def delete_all_relation_tuples(self, query: RelationQuery) -> None:
+        with self._lock:
+            for t in [t for t in self._tuples if query.matches(t)]:
+                del self._tuples[t]
+            v = self._bump()
+        self._notify(v)
+
+    def transact_relation_tuples(
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+    ) -> None:
+        """Atomic insert+delete: validation failures roll back the whole batch
+        (reference relationtuples.go:290-297; rollback behavior tested in
+        manager_requirements.go:399-445)."""
+        for t in insert:
+            self._validate(t)
+        with self._lock:
+            for t in insert:
+                if t not in self._tuples:
+                    self._tuples[t] = self._seq
+                    self._seq += 1
+            for t in delete:
+                self._tuples.pop(t, None)
+            v = self._bump()
+        self._notify(v)
+
+    # -- snapshot support -----------------------------------------------------
+
+    def all_tuples(self) -> list[RelationTuple]:
+        with self._lock:
+            return list(self._tuples)
+
+    def snapshot(self) -> tuple[list[RelationTuple], int]:
+        """Consistent (tuples, version) pair for the encoder."""
+        with self._lock:
+            return list(self._tuples), self._version
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._tuples)

@@ -1,0 +1,31 @@
+from .errors import (
+    ErrForbidden,
+    ErrInternal,
+    ErrInvalidTuple,
+    ErrMalformedInput,
+    ErrMalformedPageToken,
+    ErrNamespaceNotFound,
+    ErrNotFound,
+    KetoError,
+)
+from .pagination import (
+    DEFAULT_PAGE_SIZE,
+    PaginationOptions,
+    decode_page_token,
+    encode_page_token,
+)
+
+__all__ = [
+    "DEFAULT_PAGE_SIZE",
+    "ErrForbidden",
+    "ErrInternal",
+    "ErrInvalidTuple",
+    "ErrMalformedInput",
+    "ErrMalformedPageToken",
+    "ErrNamespaceNotFound",
+    "ErrNotFound",
+    "KetoError",
+    "PaginationOptions",
+    "decode_page_token",
+    "encode_page_token",
+]

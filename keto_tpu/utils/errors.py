@@ -1,0 +1,86 @@
+"""Herodot-style rich errors.
+
+The reference surfaces errors through ory/herodot: every error carries an HTTP
+status, a gRPC code, and a JSON envelope ``{"error": {...}}`` (see reference
+internal/x and the herodot dependency in go.mod). We reproduce the same error
+taxonomy so REST/gRPC handlers can map domain failures to the exact wire
+semantics (e.g. unknown namespace -> 404, malformed tuple -> 400).
+"""
+
+from __future__ import annotations
+
+
+class KetoError(Exception):
+    """Base domain error with HTTP + gRPC mapping."""
+
+    status_code = 500
+    status = "Internal Server Error"
+    grpc_code = "INTERNAL"
+    reason = ""
+
+    def __init__(self, message: str | None = None, reason: str | None = None):
+        super().__init__(message or self.default_message())
+        self.message = message or self.default_message()
+        if reason is not None:
+            self.reason = reason
+
+    def default_message(self) -> str:
+        return self.status
+
+    def envelope(self) -> dict:
+        """JSON body matching herodot's error envelope."""
+        err = {
+            "code": self.status_code,
+            "status": self.status,
+            "message": self.message,
+        }
+        if self.reason:
+            err["reason"] = self.reason
+        return {"error": err}
+
+
+class ErrNotFound(KetoError):
+    status_code = 404
+    status = "Not Found"
+    grpc_code = "NOT_FOUND"
+
+
+class ErrNamespaceNotFound(ErrNotFound):
+    def __init__(self, namespace: str = ""):
+        self.namespace = namespace
+        super().__init__(
+            f"Unknown namespace {namespace!r}. Please add it to the configuration first."
+            if namespace
+            else None
+        )
+
+
+class ErrMalformedInput(KetoError):
+    status_code = 400
+    status = "Bad Request"
+    grpc_code = "INVALID_ARGUMENT"
+
+    def default_message(self) -> str:
+        return "The provided input was malformed."
+
+
+class ErrMalformedPageToken(ErrMalformedInput):
+    def default_message(self) -> str:
+        return "The provided page token is malformed."
+
+
+class ErrInvalidTuple(ErrMalformedInput):
+    def default_message(self) -> str:
+        return "The provided relation tuple is invalid."
+
+
+class ErrForbidden(KetoError):
+    status_code = 403
+    status = "Forbidden"
+    grpc_code = "PERMISSION_DENIED"
+
+
+class ErrInternal(KetoError):
+    status_code = 500
+    status = "Internal Server Error"
+    grpc_code = "INTERNAL"

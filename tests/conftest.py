@@ -1,0 +1,30 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual devices so multi-chip sharding
+(keto_tpu/parallel) is exercised without TPU hardware; set before any jax
+import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from keto_tpu.namespace import MemoryNamespaceManager  # noqa: E402
+from keto_tpu.store import InMemoryTupleStore  # noqa: E402
+
+
+@pytest.fixture
+def nsmgr():
+    return MemoryNamespaceManager()
+
+
+@pytest.fixture
+def store(nsmgr):
+    return InMemoryTupleStore(namespace_manager=nsmgr)

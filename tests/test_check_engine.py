@@ -1,0 +1,181 @@
+"""Check-engine scenario tests — ports of the reference's engine suite
+(reference internal/check/engine_test.go:45-581): direct/indirect inclusion,
+exclusion, wrong object/relation, max-depth precedence, transitive rejection,
+subject-id-next-to-subject-set, pagination behavior, wide graphs, circular
+tuples."""
+
+import pytest
+
+from keto_tpu.engine.check import CheckEngine
+from keto_tpu.namespace import MemoryNamespaceManager
+from keto_tpu.relationtuple import (
+    ManagerWrapper,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+)
+from keto_tpu.store import InMemoryTupleStore
+
+
+def make_env(*namespaces, page_size=0):
+    nsmgr = MemoryNamespaceManager()
+    for n in namespaces:
+        nsmgr.add(n)
+    store = InMemoryTupleStore(namespace_manager=nsmgr)
+    wrapped = ManagerWrapper(store, page_size=page_size)
+    return store, wrapped, CheckEngine(wrapped)
+
+
+def T(ns, obj, rel, subject):
+    return RelationTuple(ns, obj, rel, subject)
+
+
+class TestCheckEngine:
+    def test_direct_inclusion(self):
+        store, _, e = make_env("n")
+        rel = T("n", "obj", "rel", SubjectID("user"))
+        store.write_relation_tuples(rel)
+        assert e.subject_is_allowed(rel)
+
+    def test_direct_exclusion(self):
+        store, _, e = make_env("n")
+        store.write_relation_tuples(T("n", "obj", "rel", SubjectID("user-a")))
+        assert not e.subject_is_allowed(T("n", "obj", "rel", SubjectID("user-b")))
+
+    def test_wrong_object(self):
+        store, _, e = make_env("n")
+        store.write_relation_tuples(T("n", "object-a", "rel", SubjectID("user")))
+        assert not e.subject_is_allowed(T("n", "object-b", "rel", SubjectID("user")))
+
+    def test_wrong_relation(self):
+        store, _, e = make_env("n")
+        store.write_relation_tuples(T("n", "obj", "rel-a", SubjectID("user")))
+        assert not e.subject_is_allowed(T("n", "obj", "rel-b", SubjectID("user")))
+
+    def test_indirect_inclusion_level_1(self):
+        # user is member of org; org members have access to obj
+        store, _, e = make_env("n")
+        store.write_relation_tuples(
+            T("n", "org", "member", SubjectID("user")),
+            T("n", "obj", "access", SubjectSet("n", "org", "member")),
+        )
+        assert e.subject_is_allowed(T("n", "obj", "access", SubjectID("user")))
+
+    def test_indirect_inclusion_level_2(self):
+        store, _, e = make_env("n")
+        store.write_relation_tuples(
+            T("n", "team", "member", SubjectID("user")),
+            T("n", "org", "member", SubjectSet("n", "team", "member")),
+            T("n", "obj", "access", SubjectSet("n", "org", "member")),
+        )
+        assert e.subject_is_allowed(T("n", "obj", "access", SubjectID("user")))
+
+    def test_subject_set_as_requested_subject(self):
+        # the requested subject may itself be a subject set
+        store, _, e = make_env("n")
+        store.write_relation_tuples(
+            T("n", "obj", "access", SubjectSet("n", "org", "member")),
+        )
+        assert e.subject_is_allowed(
+            T("n", "obj", "access", SubjectSet("n", "org", "member"))
+        )
+
+    def test_respects_max_depth(self):
+        # reference engine_test.go:46-119: access <- owner <- admin <- user
+        # requires depth 3; request and global max-depth interplay
+        store, _, e = make_env("test")
+        store.write_relation_tuples(
+            T("test", "object", "admin", SubjectID("user")),
+            T("test", "object", "owner", SubjectSet("test", "object", "admin")),
+            T("test", "object", "access", SubjectSet("test", "object", "owner")),
+        )
+        req = T("test", "object", "access", SubjectID("user"))
+        assert e.global_max_depth == 5
+        # request max-depth takes precedence: 2 not enough, 3 enough
+        assert not e.subject_is_allowed(req, 2)
+        assert e.subject_is_allowed(req, 3)
+        # global max-depth takes precedence when lesser
+        e.global_max_depth = 2
+        assert not e.subject_is_allowed(req, 3)
+        # ...and when the request depth is 0
+        e.global_max_depth = 3
+        assert e.subject_is_allowed(req, 0)
+
+    def test_rejects_transitive_relation(self):
+        # (file) <-parent- (directory) <-access- [user]: no userset rewrite,
+        # so access to the parent does not grant access to the file
+        # (reference engine_test.go:348-387)
+        store, _, e = make_env("")
+        store.write_relation_tuples(
+            T("", "file", "parent", SubjectSet("", "directory", "")),
+            T("", "directory", "access", SubjectID("user")),
+        )
+        assert not e.subject_is_allowed(T("", "file", "access", SubjectID("user")))
+
+    def test_subject_id_next_to_subject_set(self):
+        # reference engine_test.go:388-440
+        store, _, e = make_env("namesp")
+        store.write_relation_tuples(
+            T("namesp", "obj", "owner", SubjectID("u1")),
+            T("namesp", "obj", "owner", SubjectSet("namesp", "org", "member")),
+            T("namesp", "org", "member", SubjectID("u2")),
+        )
+        assert e.subject_is_allowed(T("namesp", "obj", "owner", SubjectID("u1")))
+        assert e.subject_is_allowed(T("namesp", "obj", "owner", SubjectID("u2")))
+
+    def test_paginates(self):
+        # reference engine_test.go:441-485 asserts the engine walks pages via
+        # the returned tokens; the ManagerWrapper spy records requested tokens
+        store, wrapped, e = make_env("namesp", page_size=2)
+        users = ["u1", "u2", "u3", "u4"]
+        for u in users:
+            store.write_relation_tuples(T("namesp", "obj", "access", SubjectID(u)))
+        for i, u in enumerate(users):
+            wrapped.requested_pages.clear()
+            assert e.subject_is_allowed(T("namesp", "obj", "access", SubjectID(u)))
+            # first page always requested with the empty token
+            assert wrapped.requested_pages[0] == ""
+            # u1/u2 live on page one; u3/u4 require a second page request
+            assert len(wrapped.requested_pages) == (1 if i < 2 else 2)
+
+    def test_wide_tuple_graph(self):
+        # many sibling orgs; only one grants access (engine_test.go:487-528)
+        store, _, e = make_env("n")
+        width = 120  # spans multiple pages
+        for i in range(width):
+            store.write_relation_tuples(
+                T("n", "obj", "access", SubjectSet("n", f"org-{i}", "member"))
+            )
+        store.write_relation_tuples(T("n", f"org-{width - 1}", "member", SubjectID("user")))
+        assert e.subject_is_allowed(T("n", "obj", "access", SubjectID("user")))
+        assert not e.subject_is_allowed(T("n", "obj", "access", SubjectID("nobody")))
+
+    def test_circular_tuples(self):
+        # A -connected-> B -connected-> C -connected-> A; a SubjectID that is
+        # nowhere in the cycle must terminate and be denied
+        # (reference engine_test.go:529-581)
+        store, _, e = make_env("m")
+        a, b, c = "Sendlinger Tor", "Odeonsplatz", "Central Station"
+        store.write_relation_tuples(
+            T("m", a, "connected", SubjectSet("m", b, "connected")),
+            T("m", b, "connected", SubjectSet("m", c, "connected")),
+            T("m", c, "connected", SubjectSet("m", a, "connected")),
+        )
+        assert not e.subject_is_allowed(T("m", a, "connected", SubjectID(c)))
+
+    def test_unknown_namespace_is_denied(self):
+        _, _, e = make_env("known")
+        assert not e.subject_is_allowed(T("unknown", "o", "r", SubjectID("u")))
+
+    def test_batch_check(self):
+        store, _, e = make_env("n")
+        store.write_relation_tuples(
+            T("n", "org", "member", SubjectID("user")),
+            T("n", "obj", "access", SubjectSet("n", "org", "member")),
+        )
+        reqs = [
+            T("n", "obj", "access", SubjectID("user")),
+            T("n", "obj", "access", SubjectID("other")),
+            T("n", "org", "member", SubjectID("user")),
+        ]
+        assert e.batch_check(reqs) == [True, False, True]

@@ -1,0 +1,101 @@
+"""Domain-model tests mirroring reference internal/relationtuple tests:
+string grammar round-trips, subject parsing, query matching."""
+
+import pytest
+
+from keto_tpu.relationtuple import (
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+    parse_tuples_text,
+    subject_from_string,
+)
+from keto_tpu.utils import ErrMalformedInput
+
+
+class TestSubjectGrammar:
+    def test_plain_id(self):
+        assert subject_from_string("user1") == SubjectID(id="user1")
+
+    def test_subject_set(self):
+        assert subject_from_string("ns:obj#rel") == SubjectSet(
+            namespace="ns", object="obj", relation="rel"
+        )
+
+    def test_string_roundtrip(self):
+        for s in ["user1", "ns:obj#rel", "n:o#"]:
+            assert str(subject_from_string(s)) == s
+
+    def test_hash_means_subject_set(self):
+        # '#'-detection: reference definitions.go:137-142; a '#' without a
+        # ':' cannot form a valid subject set
+        with pytest.raises(ErrMalformedInput):
+            subject_from_string("obj#rel")
+
+
+class TestTupleGrammar:
+    def test_parse_simple(self):
+        t = RelationTuple.from_string("n:o#r@s")
+        assert t == RelationTuple("n", "o", "r", SubjectID("s"))
+
+    def test_parse_subject_set(self):
+        t = RelationTuple.from_string("n:o#r@n2:o2#r2")
+        assert t.subject == SubjectSet("n2", "o2", "r2")
+
+    def test_parse_parenthesized_subject_set(self):
+        t = RelationTuple.from_string("n:o#r@(n2:o2#r2)")
+        assert t.subject == SubjectSet("n2", "o2", "r2")
+
+    def test_split_on_first_separator(self):
+        # splits at the FIRST ':', '#', '@' (reference definitions.go:276-305)
+        t = RelationTuple.from_string("n:o:x#r@s")
+        assert t.namespace == "n" and t.object == "o:x"
+
+    def test_malformed(self):
+        for s in ["no-colon", "n:no-hash", "n:o#no-at"]:
+            with pytest.raises(ErrMalformedInput):
+                RelationTuple.from_string(s)
+
+    def test_string_roundtrip(self):
+        for s in ["n:o#r@s", "n:o#r@n2:o2#r2"]:
+            assert str(RelationTuple.from_string(s)) == s
+
+    def test_json_roundtrip(self):
+        for t in [
+            RelationTuple("n", "o", "r", SubjectID("s")),
+            RelationTuple("n", "o", "r", SubjectSet("a", "b", "c")),
+        ]:
+            assert RelationTuple.from_dict(t.to_dict()) == t
+
+    def test_parse_text_with_comments(self):
+        text = """
+        // a comment
+        n:o#r@s
+
+        n:o#r@x // trailing
+        """
+        ts = parse_tuples_text(text)
+        assert [str(t) for t in ts] == ["n:o#r@s", "n:o#r@x"]
+
+
+class TestRelationQuery:
+    def setup_method(self):
+        self.t = RelationTuple("n", "o", "r", SubjectID("s"))
+
+    def test_wildcards(self):
+        assert RelationQuery().matches(self.t)
+        assert RelationQuery(namespace="n").matches(self.t)
+        assert not RelationQuery(namespace="m").matches(self.t)
+        assert RelationQuery(namespace="n", object="o", relation="r").matches(self.t)
+        assert not RelationQuery(subject=SubjectID("z")).matches(self.t)
+        assert RelationQuery(subject=SubjectID("s")).matches(self.t)
+
+    def test_subject_set_query(self):
+        t = RelationTuple("n", "o", "r", SubjectSet("a", "b", "c"))
+        assert RelationQuery(subject=SubjectSet("a", "b", "c")).matches(t)
+        assert not RelationQuery(subject=SubjectID("a")).matches(t)
+
+    def test_dict_roundtrip(self):
+        q = RelationQuery(namespace="n", subject=SubjectSet("a", "b", "c"))
+        assert RelationQuery.from_dict(q.to_dict()) == q

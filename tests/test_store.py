@@ -1,0 +1,190 @@
+"""Manager contract tests — the port of the reference's exported contract
+suites (reference internal/relationtuple/manager_requirements.go:19-447 and
+manager_isolation.go:44-138). Any tuple-store backend must pass these."""
+
+import pytest
+
+from keto_tpu.namespace import MemoryNamespaceManager
+from keto_tpu.relationtuple import (
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+)
+from keto_tpu.store import InMemoryTupleStore
+from keto_tpu.utils import (
+    ErrMalformedPageToken,
+    ErrNotFound,
+    PaginationOptions,
+)
+
+
+@pytest.fixture
+def ns(nsmgr):
+    def add(name):
+        nsmgr.add(name)
+        return name
+
+    return add
+
+
+class TestWrite:
+    def test_write_and_read_back(self, store, ns):
+        nspace = ns("write-ns")
+        tuples = [
+            RelationTuple(nspace, "obj", "rel", SubjectID("sub")),
+            RelationTuple(nspace, "obj", "rel", SubjectSet(nspace, "sub obj", "sub rel")),
+        ]
+        store.write_relation_tuples(*tuples)
+        for t in tuples:
+            resp, next_page = store.get_relation_tuples(t.to_query())
+            assert next_page == ""
+            assert resp == [t]
+
+    def test_unknown_namespace(self, store):
+        with pytest.raises(ErrNotFound):
+            store.write_relation_tuples(
+                RelationTuple("unknown namespace", "", "", SubjectID(""))
+            )
+
+
+class TestGet:
+    def test_query_combinations(self, store, ns):
+        nspace = ns("get-ns")
+        tuples = [
+            RelationTuple(nspace, f"o {i % 2}", f"r {i % 4}", SubjectID(f"s {i}"))
+            for i in range(10)
+        ]
+        store.write_relation_tuples(*tuples)
+
+        cases = [
+            (RelationQuery(namespace=nspace), tuples),
+            (RelationQuery(namespace=nspace, object="o 0"), tuples[0::2]),
+            (RelationQuery(namespace=nspace, relation="r 0"), tuples[0::4]),
+            (
+                RelationQuery(namespace=nspace, object="o 0", relation="r 0"),
+                [tuples[0], tuples[4], tuples[8]],
+            ),
+            (
+                RelationQuery(namespace=nspace, subject=SubjectID("s 3")),
+                [tuples[3]],
+            ),
+            (
+                RelationQuery(
+                    namespace=nspace, object="o 1", relation="r 1", subject=SubjectID("s 1")
+                ),
+                [tuples[1]],
+            ),
+        ]
+        for query, expected in cases:
+            resp, next_page = store.get_relation_tuples(query)
+            assert next_page == ""
+            assert resp == expected
+
+    def test_unknown_namespace_query(self, store):
+        with pytest.raises(ErrNotFound):
+            store.get_relation_tuples(RelationQuery(namespace="nope"))
+
+    def test_pagination(self, store, ns):
+        nspace = ns("page-ns")
+        tuples = [
+            RelationTuple(nspace, "o", "r", SubjectID(f"s{i:03d}")) for i in range(25)
+        ]
+        store.write_relation_tuples(*tuples)
+
+        seen, token, pages = [], "", 0
+        while True:
+            resp, token = store.get_relation_tuples(
+                RelationQuery(namespace=nspace),
+                PaginationOptions(token=token, size=10),
+            )
+            seen += resp
+            pages += 1
+            if not token:
+                break
+        assert pages == 3
+        assert seen == tuples
+
+    def test_malformed_page_token(self, store, ns):
+        nspace = ns("tok-ns")
+        with pytest.raises(ErrMalformedPageToken):
+            store.get_relation_tuples(
+                RelationQuery(namespace=nspace),
+                PaginationOptions(token="not a token !!"),
+            )
+
+
+class TestDelete:
+    def test_delete(self, store, ns):
+        nspace = ns("del-ns")
+        keep = RelationTuple(nspace, "o", "r", SubjectID("keep"))
+        kill = RelationTuple(nspace, "o", "r", SubjectID("kill"))
+        store.write_relation_tuples(keep, kill)
+        store.delete_relation_tuples(kill)
+        resp, _ = store.get_relation_tuples(RelationQuery(namespace=nspace))
+        assert resp == [keep]
+
+    def test_delete_all_by_query(self, store, ns):
+        nspace = ns("delall-ns")
+        a = [RelationTuple(nspace, "a", "r", SubjectID(f"s{i}")) for i in range(3)]
+        b = [RelationTuple(nspace, "b", "r", SubjectID(f"s{i}")) for i in range(3)]
+        store.write_relation_tuples(*a, *b)
+        store.delete_all_relation_tuples(RelationQuery(namespace=nspace, object="a"))
+        resp, _ = store.get_relation_tuples(RelationQuery(namespace=nspace))
+        assert resp == b
+
+
+class TestTransact:
+    def test_insert_and_delete_atomically(self, store, ns):
+        nspace = ns("tx-ns")
+        old = RelationTuple(nspace, "o", "r", SubjectID("old"))
+        new = RelationTuple(nspace, "o", "r", SubjectID("new"))
+        store.write_relation_tuples(old)
+        store.transact_relation_tuples(insert=[new], delete=[old])
+        resp, _ = store.get_relation_tuples(RelationQuery(namespace=nspace))
+        assert resp == [new]
+
+    def test_rollback_on_invalid_insert(self, store, ns):
+        # reference manager_requirements.go:399-445: a failing insert must
+        # leave previously-existing state untouched and apply nothing
+        nspace = ns("rb-ns")
+        existing = RelationTuple(nspace, "o", "r", SubjectID("existing"))
+        store.write_relation_tuples(existing)
+        good = RelationTuple(nspace, "o", "r", SubjectID("good"))
+        bad = RelationTuple("unknown-ns", "o", "r", SubjectID("bad"))
+        with pytest.raises(ErrNotFound):
+            store.transact_relation_tuples(insert=[good, bad], delete=[existing])
+        resp, _ = store.get_relation_tuples(RelationQuery(namespace=nspace))
+        assert resp == [existing]
+
+
+class TestIsolation:
+    def test_network_isolation(self):
+        # two stores with different network ids over the same namespace
+        # config must not see each other's tuples
+        # (reference manager_isolation.go:44-138)
+        nsmgr = MemoryNamespaceManager()
+        nsmgr.add("iso")
+        s1 = InMemoryTupleStore(namespace_manager=nsmgr, network_id="net-1")
+        s2 = InMemoryTupleStore(namespace_manager=nsmgr, network_id="net-2")
+        t = RelationTuple("iso", "o", "r", SubjectID("s"))
+        s1.write_relation_tuples(t)
+        assert s1.get_relation_tuples(RelationQuery(namespace="iso"))[0] == [t]
+        assert s2.get_relation_tuples(RelationQuery(namespace="iso"))[0] == []
+
+
+class TestVersionCounter:
+    def test_version_bumps_on_mutation(self, store, ns):
+        nspace = ns("ver-ns")
+        v0 = store.version
+        store.write_relation_tuples(RelationTuple(nspace, "o", "r", SubjectID("s")))
+        assert store.version == v0 + 1
+        store.delete_all_relation_tuples(RelationQuery(namespace=nspace))
+        assert store.version == v0 + 2
+
+    def test_subscribe(self, store, ns):
+        nspace = ns("sub-ns")
+        got = []
+        store.subscribe(got.append)
+        store.write_relation_tuples(RelationTuple(nspace, "o", "r", SubjectID("s")))
+        assert got == [store.version]
