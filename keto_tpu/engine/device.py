@@ -1,0 +1,248 @@
+"""Device-backed check/expand engines.
+
+``DeviceCheckEngine`` answers the same contract as the host ``CheckEngine``
+(reference Engine.SubjectIsAllowed, internal/check/engine.go:116-123) but
+evaluates whole batches on the accelerator: requests are vocab-encoded to
+(start, target, depth) int32 triples, padded to a batch bucket, and handed to
+the jitted frontier kernels (keto_tpu.ops.frontier). Depth clamping matches
+the reference (global serve.read.max-depth wins when smaller or when the
+request depth is <= 0).
+
+``SnapshotExpandEngine`` builds the same union/leaf subject tree as the host
+expand engine (reference internal/expand/engine.go:33-102) but walks the
+resident CSR arrays instead of issuing per-node paginated store queries —
+the traversal itself is host-side (tree materialization is inherently a
+host-shaped output), yet touches no store pages.
+
+Freshness: engines read through a SnapshotManager, so every answer is at
+least as fresh as the store version at call time — the version is the
+snaptoken the reference never implemented (its Check returns
+`snaptoken: "not yet implemented"`, internal/check/handler.go:168-184).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph.snapshot import GraphSnapshot, SnapshotManager, _bucket
+from ..ops.frontier import (
+    batched_check_dense,
+    batched_check_scatter,
+    batched_distances_dense,
+    batched_distances_scatter,
+    build_dense_adjacency,
+    pick_edge_chunk,
+)
+from ..relationtuple.definitions import RelationTuple, Subject, SubjectSet
+from .check import DEFAULT_MAX_DEPTH, clamp_depth
+from .tree import Tree, NodeType
+
+_MIN_BATCH = 8
+_DENSE_THRESHOLD_DEFAULT = 8192  # adj = bf16 N*N: 8192^2 = 128 MiB in HBM
+
+
+def _bucket_batch(b: int) -> int:
+    return _bucket(b, _MIN_BATCH)
+
+
+class _DeviceGraph:
+    """Per-snapshot device residency: uploaded COO arrays or dense adjacency."""
+
+    def __init__(self, snap: GraphSnapshot, dense: bool):
+        self.host_src = snap.src  # identity keys for the residency cache:
+        self.host_dst = snap.dst  # equal arrays => equal device contents
+        self.padded_nodes = snap.padded_nodes
+        self.padded_edges = snap.padded_edges
+        self.dense = dense
+        if dense:
+            self.adj = build_dense_adjacency(
+                jnp.asarray(snap.src), jnp.asarray(snap.dst), snap.padded_nodes
+            )
+            self.src = self.dst = None
+        else:
+            self.adj = None
+            self.src = jnp.asarray(snap.src)
+            self.dst = jnp.asarray(snap.dst)
+
+
+class DeviceCheckEngine:
+    def __init__(
+        self,
+        snapshots: SnapshotManager,
+        max_depth: int = DEFAULT_MAX_DEPTH,
+        mode: str = "auto",  # auto | dense | scatter
+        dense_threshold: int = _DENSE_THRESHOLD_DEFAULT,
+    ):
+        self.snapshots = snapshots
+        self.global_max_depth = max_depth
+        self.mode = mode
+        self.dense_threshold = dense_threshold
+        self._lock = threading.Lock()
+        self._cached: Optional[_DeviceGraph] = None
+
+    # -- device residency ----------------------------------------------------
+
+    def _device_graph(self, snap: GraphSnapshot) -> _DeviceGraph:
+        with self._lock:
+            cached = self._cached
+            # keyed on edge-array identity, not snapshot identity: version-only
+            # snapshots (duplicate writes) share arrays and must not trigger a
+            # re-upload or dense-adjacency rebuild
+            if (
+                cached is not None
+                and cached.host_src is snap.src
+                and cached.host_dst is snap.dst
+            ):
+                return cached
+            if self.mode == "dense":
+                dense = True
+            elif self.mode == "scatter":
+                dense = False
+            else:
+                dense = snap.padded_nodes <= self.dense_threshold
+            dg = _DeviceGraph(snap, dense)
+            self._cached = dg
+            return dg
+
+    # -- public API ----------------------------------------------------------
+
+    def subject_is_allowed(
+        self, requested: RelationTuple, max_depth: int = 0
+    ) -> bool:
+        return self.batch_check([requested], max_depth)[0]
+
+    def batch_check(
+        self,
+        requests: Sequence[RelationTuple],
+        max_depth: int = 0,
+        depths: Optional[Sequence[int]] = None,
+    ) -> list[bool]:
+        """Evaluate a batch; `depths` (per-request) overrides `max_depth`."""
+        if not requests:
+            return []
+        snap = self.snapshots.snapshot()
+        dg = self._device_graph(snap)
+        n = len(requests)
+        b = _bucket_batch(n)
+        dummy = snap.dummy_node
+        start = np.full(b, dummy, dtype=np.int32)
+        target = np.full(b, dummy, dtype=np.int32)
+        depth = np.ones(b, dtype=np.int32)
+        for i, r in enumerate(requests):
+            start[i] = snap.node_for_set(r.namespace, r.object, r.relation)
+            target[i] = snap.node_for_subject(r.subject)
+            want = depths[i] if depths is not None else max_depth
+            depth[i] = clamp_depth(want, self.global_max_depth)
+        if dg.dense:
+            hit = batched_check_dense(
+                dg.adj,
+                jnp.asarray(start),
+                jnp.asarray(target),
+                jnp.asarray(depth),
+                max_steps=self.global_max_depth,
+            )
+        else:
+            chunk = pick_edge_chunk(dg.padded_edges, b)
+            hit = batched_check_scatter(
+                dg.src,
+                dg.dst,
+                jnp.asarray(start),
+                jnp.asarray(target),
+                jnp.asarray(depth),
+                padded_nodes=dg.padded_nodes,
+                edge_chunk=chunk,
+                max_steps=self.global_max_depth,
+            )
+        return np.asarray(hit)[:n].tolist()
+
+    def distances(
+        self, subject_sets: Sequence[SubjectSet], max_depth: int = 0
+    ) -> np.ndarray:
+        """BFS levels int32[B, padded_nodes] from each subject set (UNREACHED
+        sentinel where unreachable) — device-side bulk expand support."""
+        snap = self.snapshots.snapshot()
+        dg = self._device_graph(snap)
+        n = len(subject_sets)
+        b = _bucket_batch(n)
+        dummy = snap.dummy_node
+        start = np.full(b, dummy, dtype=np.int32)
+        for i, s in enumerate(subject_sets):
+            start[i] = snap.node_for_set(s.namespace, s.object, s.relation)
+        d = clamp_depth(max_depth, self.global_max_depth)
+        depth = np.full(b, d, dtype=np.int32)
+        if dg.dense:
+            dist = batched_distances_dense(
+                dg.adj,
+                jnp.asarray(start),
+                jnp.asarray(depth),
+                max_steps=self.global_max_depth,
+            )
+        else:
+            chunk = pick_edge_chunk(dg.padded_edges, b)
+            dist = batched_distances_scatter(
+                dg.src,
+                dg.dst,
+                jnp.asarray(start),
+                jnp.asarray(depth),
+                padded_nodes=dg.padded_nodes,
+                edge_chunk=chunk,
+                max_steps=self.global_max_depth,
+            )
+        return np.asarray(dist)[:n]
+
+
+class SnapshotExpandEngine:
+    """Expand-tree construction over the resident CSR (no store round-trips).
+
+    Matches the host ExpandEngine (reference internal/expand/engine.go:33-102)
+    node for node: SubjectID -> Leaf; a subject set already visited or with no
+    tuples -> no node; remaining depth <= 1 -> Leaf; otherwise Union over the
+    expansions of each tuple's subject, in store insertion order (the CSR's
+    stable sort preserves it).
+    """
+
+    def __init__(
+        self, snapshots: SnapshotManager, max_depth: int = DEFAULT_MAX_DEPTH
+    ):
+        self.snapshots = snapshots
+        self.global_max_depth = max_depth
+
+    def build_tree(
+        self, subject: Subject, max_depth: int = 0
+    ) -> Optional[Tree]:
+        depth = clamp_depth(max_depth, self.global_max_depth)
+        snap = self.snapshots.snapshot()
+        visited: set[int] = set()
+        return self._expand(snap, subject, depth, visited)
+
+    def _expand(
+        self,
+        snap: GraphSnapshot,
+        subject: Subject,
+        rest_depth: int,
+        visited: set[int],
+    ) -> Optional[Tree]:
+        if not isinstance(subject, SubjectSet):
+            return Tree(type=NodeType.LEAF, subject=subject)
+        nid = snap.vocab.lookup_subject(subject)
+        if nid is None:
+            return None  # set never appears as an object#relation: no tuples
+        if nid in visited:
+            return None  # cycle suppression (engine.go:42-45)
+        visited.add(nid)
+        successors = snap.out_neighbors(nid)
+        if successors.size == 0:
+            return None  # no tuples (engine.go:67-69)
+        if rest_depth <= 1:
+            return Tree(type=NodeType.LEAF, subject=subject)
+        children = []
+        for child_nid in successors:
+            child_subject = snap.vocab.subject_of(int(child_nid))
+            child = self._expand(snap, child_subject, rest_depth - 1, visited)
+            if child is not None:
+                children.append(child)
+        return Tree(type=NodeType.UNION, subject=subject, children=children)

@@ -1,0 +1,19 @@
+"""Graph encoding layer: tuple store -> device-resident arrays.
+
+This is the component that replaces the reference's SQL round-trips with a
+TPU-resident representation (SURVEY.md §7 step 3): relation tuples become
+edges of a directed graph over interned int32 node ids, encoded as padded
+COO/CSR arrays that the batched check/expand kernels (keto_tpu.ops) consume.
+"""
+
+from .vocab import NodeVocab, id_key, set_key
+from .snapshot import GraphSnapshot, SnapshotBuilder, SnapshotManager
+
+__all__ = [
+    "NodeVocab",
+    "id_key",
+    "set_key",
+    "GraphSnapshot",
+    "SnapshotBuilder",
+    "SnapshotManager",
+]

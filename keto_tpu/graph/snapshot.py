@@ -1,0 +1,264 @@
+"""Graph snapshots: the tuple store encoded as padded device arrays.
+
+The reference answers Check/Expand with one SQL round-trip per subject-set
+node per page (internal/check/engine.go:82-114). Here the whole tuple graph is
+instead kept resident as arrays, and a batch of checks advances in lockstep
+(SURVEY.md §7). A snapshot is an immutable value:
+
+- ``src``/``dst``: int32 COO edge list, one edge per relation tuple,
+  ``intern(ns,obj,rel) -> intern(subject)``. Padding edges point dummy->dummy
+  so the propagate kernel never special-cases length.
+- ``padded_nodes``/``padded_edges`` are bucketed (powers of two) so jit
+  signatures stay stable while the graph grows — dynamic shapes would force
+  XLA recompilation on every write.
+- ``version`` is the store's monotonic write counter: the honest
+  implementation of the snapshot token ("snaptoken") the reference stubs out
+  (check_service.proto "not yet implemented"; SURVEY.md §5 checkpoint/resume).
+
+COO (not CSR) is the propagation format on purpose: scatter-max propagation
+is order-independent, so an incremental write can *append* edges into spare
+capacity without re-sorting — the device delta path. CSR (indptr/indices) is
+derived lazily for row-structured kernels and host-side traversal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import weakref
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..relationtuple.definitions import RelationTuple, Subject
+from .vocab import NodeVocab, set_key, subject_node_key
+
+_MIN_NODES = 1024
+_MIN_EDGES = 1024
+
+
+def _bucket(n: int, minimum: int) -> int:
+    """Next power of two >= max(n, minimum)."""
+    n = max(n, minimum)
+    return 1 << (n - 1).bit_length()
+
+
+@dataclass
+class GraphSnapshot:
+    """Immutable encoded graph at one store version."""
+
+    vocab: NodeVocab
+    src: np.ndarray  # int32[padded_edges]
+    dst: np.ndarray  # int32[padded_edges]
+    num_nodes: int  # live interned nodes
+    num_edges: int  # live edges (edges [0, num_edges) are real)
+    padded_nodes: int  # frontier width; dummy node = padded_nodes - 1
+    padded_edges: int
+    version: int  # store version at encode time == snaptoken
+    _csr: Optional[tuple[np.ndarray, np.ndarray]] = field(
+        default=None, repr=False, compare=False
+    )
+
+    @property
+    def dummy_node(self) -> int:
+        return self.padded_nodes - 1
+
+    def node_for_subject(self, subject: Subject) -> int:
+        """Node id, or the dummy node when the subject was never seen (its
+        frontier bit can never be set, so unknown subjects check to False —
+        matching the reference returning false for subjects with no tuples).
+        The shared vocab may already hold ids beyond this snapshot's width
+        (a concurrent write interned them); those are unknown *here*."""
+        nid = self.vocab.lookup(subject_node_key(subject))
+        if nid is None or nid >= self.padded_nodes:
+            return self.dummy_node
+        return nid
+
+    def node_for_set(self, namespace: str, object: str, relation: str) -> int:
+        nid = self.vocab.lookup(set_key(namespace, object, relation))
+        if nid is None or nid >= self.padded_nodes:
+            return self.dummy_node
+        return nid
+
+    def csr(self) -> tuple[np.ndarray, np.ndarray]:
+        """(indptr int32[padded_nodes+1], indices int32[padded_edges]) sorted
+        by source; derived on demand and cached."""
+        if self._csr is None:
+            s = self.src[: self.num_edges]
+            d = self.dst[: self.num_edges]
+            order = np.argsort(s, kind="stable")
+            counts = np.bincount(s, minlength=self.padded_nodes)
+            indptr = np.zeros(self.padded_nodes + 1, dtype=np.int32)
+            indptr[1:] = np.cumsum(counts).astype(np.int32)
+            indices = np.full(self.padded_edges, self.dummy_node, dtype=np.int32)
+            indices[: self.num_edges] = d[order]
+            self._csr = (indptr, indices)
+        return self._csr
+
+    def out_neighbors(self, nid: int) -> np.ndarray:
+        """Successor node ids of `nid` (host-side traversal, e.g. expand)."""
+        indptr, indices = self.csr()
+        if nid >= self.padded_nodes:
+            return np.empty(0, dtype=np.int32)
+        return indices[indptr[nid] : indptr[nid + 1]]
+
+
+class SnapshotBuilder:
+    """Full encode: tuples -> GraphSnapshot. Vocab may be carried over from a
+    previous snapshot so node ids stay stable across rebuilds."""
+
+    def __init__(
+        self,
+        vocab: Optional[NodeVocab] = None,
+        min_nodes: int = _MIN_NODES,
+        min_edges: int = _MIN_EDGES,
+    ):
+        self.vocab = vocab if vocab is not None else NodeVocab()
+        self.min_nodes = min_nodes
+        self.min_edges = min_edges
+
+    def build(
+        self, tuples: Sequence[RelationTuple], version: int
+    ) -> GraphSnapshot:
+        vocab = self.vocab
+        intern = vocab.intern
+        src_ids = np.empty(len(tuples), dtype=np.int32)
+        dst_ids = np.empty(len(tuples), dtype=np.int32)
+        for i, t in enumerate(tuples):
+            src_ids[i] = intern((t.namespace, t.object, t.relation))
+            dst_ids[i] = intern(subject_node_key(t.subject))
+        n = len(vocab)
+        e = len(tuples)
+        padded_nodes = _bucket(n + 1, self.min_nodes)
+        padded_edges = _bucket(e, self.min_edges)
+        dummy = padded_nodes - 1
+        src = np.full(padded_edges, dummy, dtype=np.int32)
+        dst = np.full(padded_edges, dummy, dtype=np.int32)
+        src[:e] = src_ids
+        dst[:e] = dst_ids
+        return GraphSnapshot(
+            vocab=vocab,
+            src=src,
+            dst=dst,
+            num_nodes=n,
+            num_edges=e,
+            padded_nodes=padded_nodes,
+            padded_edges=padded_edges,
+            version=version,
+        )
+
+
+class SnapshotManager:
+    """Keeps a GraphSnapshot in sync with a tuple store.
+
+    Write plane -> device refresh (SURVEY.md §2.10 "read/write plane split"):
+    subscribes to the store's delta feed. Inserts that fit spare capacity and
+    arrive in version order are applied incrementally (append edges, intern
+    new nodes); anything else (deletes, capacity growth, out-of-order
+    notifications) marks the snapshot dirty and the next read rebuilds.
+    """
+
+    def __init__(
+        self,
+        store,
+        min_nodes: int = _MIN_NODES,
+        min_edges: int = _MIN_EDGES,
+    ):
+        self._store = store
+        self._lock = threading.RLock()
+        self.min_nodes = min_nodes
+        self.min_edges = min_edges
+        self._dirty = False
+        tuples, version = store.snapshot()
+        self._snap = SnapshotBuilder(
+            min_nodes=min_nodes, min_edges=min_edges
+        ).build(tuples, version)
+        subscribe = getattr(store, "subscribe_deltas", None)
+        self._delta_cb = None
+        if subscribe is not None:
+            # weak subscription: the store must not keep dead managers alive
+            # (nor pay their per-write delta cost)
+            ref = weakref.ref(self)
+
+            def _cb(version, inserted, deleted, _ref=ref, _store=store):
+                mgr = _ref()
+                if mgr is None:
+                    unsub = getattr(_store, "unsubscribe_deltas", None)
+                    if unsub is not None:
+                        unsub(_cb)
+                    return
+                mgr._on_delta(version, inserted, deleted)
+
+            self._delta_cb = _cb
+            subscribe(_cb)
+
+    def close(self) -> None:
+        """Detach from the store's delta feed."""
+        if self._delta_cb is not None:
+            unsub = getattr(self._store, "unsubscribe_deltas", None)
+            if unsub is not None:
+                unsub(self._delta_cb)
+            self._delta_cb = None
+
+    # -- read side -----------------------------------------------------------
+
+    def snapshot(self) -> GraphSnapshot:
+        """Current snapshot; rebuilds first if marked dirty or stale."""
+        with self._lock:
+            if self._dirty or self._snap.version != self._store.version:
+                self._rebuild()
+            return self._snap
+
+    def _rebuild(self) -> None:
+        tuples, version = self._store.snapshot()
+        # Fresh vocab on rebuild: deletes may have orphaned nodes, and a fresh
+        # intern keeps ids dense. Stable-id incremental path never comes here.
+        self._snap = SnapshotBuilder(
+            min_nodes=self.min_nodes, min_edges=self.min_edges
+        ).build(tuples, version)
+        self._dirty = False
+
+    # -- write side (delta feed) ---------------------------------------------
+
+    def _on_delta(
+        self,
+        version: int,
+        inserted: Sequence[RelationTuple],
+        deleted: Sequence[RelationTuple],
+    ) -> None:
+        with self._lock:
+            snap = self._snap
+            if self._dirty or version != snap.version + 1 or deleted:
+                self._dirty = True
+                return
+            if not inserted:
+                # version-only change (e.g. duplicate write): same edges,
+                # keep the cached CSR
+                self._snap = dataclasses.replace(snap, version=version)
+                return
+            vocab = snap.vocab  # append-only: ids stay valid
+            e_new = snap.num_edges + len(inserted)
+            src_ids = [
+                vocab.intern((t.namespace, t.object, t.relation))
+                for t in inserted
+            ]
+            dst_ids = [vocab.intern(subject_node_key(t.subject)) for t in inserted]
+            n_new = len(vocab)
+            if e_new > snap.padded_edges or n_new + 1 > snap.padded_nodes:
+                self._dirty = True  # outgrew capacity: rebuild on next read
+                return
+            src = snap.src.copy()
+            dst = snap.dst.copy()
+            src[snap.num_edges : e_new] = src_ids
+            dst[snap.num_edges : e_new] = dst_ids
+            self._snap = GraphSnapshot(
+                vocab=vocab,
+                src=src,
+                dst=dst,
+                num_nodes=n_new,
+                num_edges=e_new,
+                padded_nodes=snap.padded_nodes,
+                padded_edges=snap.padded_edges,
+                version=version,
+            )

@@ -1,0 +1,83 @@
+"""Node vocabulary: string-world subjects <-> dense int32 node ids.
+
+Nodes of the permission graph are either
+
+- **subject-set vertices** ``(namespace, object, relation)`` — "everyone with
+  `relation` on `namespace:object`" (the reference's ``SubjectSet``,
+  internal/relationtuple/definitions.go:96-117), or
+- **subject-id vertices** ``(id,)`` — concrete subjects.
+
+Both kinds are interned into one id space so a relation tuple
+``ns:obj#rel@subject`` is simply the edge ``intern(ns,obj,rel) ->
+intern(subject)``. The vocabulary is append-only: ids are stable across
+incremental snapshot updates, which is what lets the delta path append edges
+without re-encoding the whole graph.
+"""
+
+from __future__ import annotations
+
+from typing import Hashable, Optional
+
+from ..relationtuple.definitions import Subject, SubjectID, SubjectSet
+
+# Node keys. A 1-tuple cannot collide with a 3-tuple, so one dict serves both
+# kinds without tagging.
+NodeKey = Hashable
+
+
+def set_key(namespace: str, object: str, relation: str) -> NodeKey:
+    return (namespace, object, relation)
+
+
+def id_key(subject_id: str) -> NodeKey:
+    return (subject_id,)
+
+
+def subject_node_key(subject: Subject) -> NodeKey:
+    if isinstance(subject, SubjectID):
+        return id_key(subject.id)
+    return set_key(subject.namespace, subject.object, subject.relation)
+
+
+class NodeVocab:
+    """Append-only bidirectional mapping NodeKey <-> int32 id."""
+
+    def __init__(self) -> None:
+        self._id_of: dict[NodeKey, int] = {}
+        self._key_of: list[NodeKey] = []
+
+    def __len__(self) -> int:
+        return len(self._key_of)
+
+    def intern(self, key: NodeKey) -> int:
+        nid = self._id_of.get(key)
+        if nid is None:
+            nid = len(self._key_of)
+            self._id_of[key] = nid
+            self._key_of.append(key)
+        return nid
+
+    def lookup(self, key: NodeKey) -> Optional[int]:
+        return self._id_of.get(key)
+
+    def key(self, nid: int) -> NodeKey:
+        return self._key_of[nid]
+
+    def subject_of(self, nid: int) -> Subject:
+        """Reconstruct the Subject a node id denotes."""
+        k = self._key_of[nid]
+        if len(k) == 1:
+            return SubjectID(id=k[0])
+        return SubjectSet(namespace=k[0], object=k[1], relation=k[2])
+
+    def intern_subject(self, subject: Subject) -> int:
+        return self.intern(subject_node_key(subject))
+
+    def lookup_subject(self, subject: Subject) -> Optional[int]:
+        return self.lookup(subject_node_key(subject))
+
+    def copy(self) -> "NodeVocab":
+        v = NodeVocab()
+        v._id_of = dict(self._id_of)
+        v._key_of = list(self._key_of)
+        return v

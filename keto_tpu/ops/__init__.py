@@ -1,0 +1,26 @@
+"""Device kernels: batched graph operations compiled by XLA for TPU.
+
+The reference's hot loop is a mutually recursive DFS with one SQL round-trip
+per subject-set node per page (internal/check/engine.go:82-114). Here the
+same question — reachability through subject-set indirections, depth-limited
+— is answered for a whole batch of requests at once by fixed-depth frontier
+expansion over the resident edge arrays (SURVEY.md §7).
+"""
+
+from .frontier import (
+    batched_check_dense,
+    batched_check_scatter,
+    batched_distances_dense,
+    batched_distances_scatter,
+    build_dense_adjacency,
+    pick_edge_chunk,
+)
+
+__all__ = [
+    "batched_check_dense",
+    "batched_check_scatter",
+    "batched_distances_dense",
+    "batched_distances_scatter",
+    "build_dense_adjacency",
+    "pick_edge_chunk",
+]

@@ -56,6 +56,9 @@ class InMemoryTupleStore(Manager):
         self.namespace_manager = namespace_manager
         self.network_id = network_id or str(uuid.uuid4())
         self._listeners: list[Callable[[int], None]] = []
+        self._delta_listeners: list[
+            Callable[[int, list[RelationTuple], list[RelationTuple]], None]
+        ] = []
 
     # -- version / change feed ------------------------------------------------
 
@@ -69,13 +72,35 @@ class InMemoryTupleStore(Manager):
         """Register a callback invoked (under no lock) after each mutation."""
         self._listeners.append(fn)
 
+    def subscribe_deltas(
+        self,
+        fn: Callable[[int, list[RelationTuple], list[RelationTuple]], None],
+    ) -> None:
+        """Register ``fn(version, inserted, deleted)`` — the write-plane feed
+        the device snapshot layer consumes for incremental refresh
+        (SURVEY.md §2.10 read/write plane split)."""
+        self._delta_listeners.append(fn)
+
+    def unsubscribe_deltas(self, fn) -> None:
+        try:
+            self._delta_listeners.remove(fn)
+        except ValueError:
+            pass
+
     def _bump(self) -> int:
         self._version += 1
         return self._version
 
-    def _notify(self, version: int) -> None:
+    def _notify(
+        self,
+        version: int,
+        inserted: list[RelationTuple] | None = None,
+        deleted: list[RelationTuple] | None = None,
+    ) -> None:
         for fn in self._listeners:
             fn(version)
+        for fn in self._delta_listeners:
+            fn(version, inserted or [], deleted or [])
 
     # -- validation -----------------------------------------------------------
 
@@ -114,26 +139,31 @@ class InMemoryTupleStore(Manager):
         for t in tuples:
             self._validate(t)
         with self._lock:
+            fresh = []
             for t in tuples:
                 if t not in self._tuples:
                     self._tuples[t] = self._seq
                     self._seq += 1
+                    fresh.append(t)
             v = self._bump()
-        self._notify(v)
+        self._notify(v, inserted=fresh)
 
     def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
         with self._lock:
+            gone = []
             for t in tuples:
-                self._tuples.pop(t, None)
+                if self._tuples.pop(t, None) is not None:
+                    gone.append(t)
             v = self._bump()
-        self._notify(v)
+        self._notify(v, deleted=gone)
 
     def delete_all_relation_tuples(self, query: RelationQuery) -> None:
         with self._lock:
-            for t in [t for t in self._tuples if query.matches(t)]:
+            gone = [t for t in self._tuples if query.matches(t)]
+            for t in gone:
                 del self._tuples[t]
             v = self._bump()
-        self._notify(v)
+        self._notify(v, deleted=gone)
 
     def transact_relation_tuples(
         self,
@@ -146,14 +176,18 @@ class InMemoryTupleStore(Manager):
         for t in insert:
             self._validate(t)
         with self._lock:
+            fresh = []
             for t in insert:
                 if t not in self._tuples:
                     self._tuples[t] = self._seq
                     self._seq += 1
+                    fresh.append(t)
+            gone = []
             for t in delete:
-                self._tuples.pop(t, None)
+                if self._tuples.pop(t, None) is not None:
+                    gone.append(t)
             v = self._bump()
-        self._notify(v)
+        self._notify(v, inserted=fresh, deleted=gone)
 
     # -- snapshot support -----------------------------------------------------
 

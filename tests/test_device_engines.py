@@ -1,0 +1,206 @@
+"""Device engines vs host oracle: the batched frontier kernels must agree
+bit-for-bit with the host BFS check engine and the store-backed expand engine
+on every graph — including cycles, unknown subjects, and depth clamping
+(the scenario matrix of reference internal/check/engine_test.go:45-581,
+re-run against the device path)."""
+
+import numpy as np
+import pytest
+
+from keto_tpu.engine import CheckEngine, ExpandEngine
+from keto_tpu.engine.device import DeviceCheckEngine, SnapshotExpandEngine
+from keto_tpu.graph import SnapshotManager
+from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+from keto_tpu.store import InMemoryTupleStore
+
+
+def t(s: str) -> RelationTuple:
+    return RelationTuple.from_string(s)
+
+
+@pytest.fixture
+def store():
+    # no namespace validation: these tests exercise the engines
+    return InMemoryTupleStore()
+
+
+def make_engines(store, mode, max_depth=5):
+    mgr = SnapshotManager(store)
+    return (
+        CheckEngine(store, max_depth=max_depth),
+        DeviceCheckEngine(mgr, max_depth=max_depth, mode=mode),
+    )
+
+
+@pytest.fixture(params=["dense", "scatter"])
+def mode(request):
+    return request.param
+
+
+class TestDeviceCheckScenarios:
+    """Reference check scenarios (engine_test.go) against the device path."""
+
+    def test_direct_inclusion(self, store, mode):
+        store.write_relation_tuples(t("n:obj#access@alice"))
+        _, dev = make_engines(store, mode)
+        assert dev.subject_is_allowed(t("n:obj#access@alice"))
+        assert not dev.subject_is_allowed(t("n:obj#access@bob"))
+
+    def test_indirect_inclusion_two_levels(self, store, mode):
+        store.write_relation_tuples(
+            t("n:obj#access@(n:org#member)"),
+            t("n:org#member@(n:team#member)"),
+            t("n:team#member@alice"),
+        )
+        _, dev = make_engines(store, mode)
+        assert dev.subject_is_allowed(t("n:obj#access@alice"))
+        assert dev.subject_is_allowed(t("n:obj#access@(n:team#member)"))
+        assert not dev.subject_is_allowed(t("n:obj#access@mallory"))
+
+    def test_wrong_object_or_relation(self, store, mode):
+        store.write_relation_tuples(t("n:obj#access@alice"))
+        _, dev = make_engines(store, mode)
+        assert not dev.subject_is_allowed(t("n:other#access@alice"))
+        assert not dev.subject_is_allowed(t("n:obj#write@alice"))
+        assert not dev.subject_is_allowed(t("other:obj#access@alice"))
+
+    def test_circular_tuples_terminate(self, store, mode):
+        store.write_relation_tuples(
+            t("n:a#r@(n:b#r)"),
+            t("n:b#r@(n:a#r)"),
+        )
+        _, dev = make_engines(store, mode)
+        assert not dev.subject_is_allowed(t("n:a#r@alice"))
+        # the sets themselves are mutually reachable
+        assert dev.subject_is_allowed(t("n:a#r@(n:a#r)"))
+
+    def test_depth_budget(self, store, mode):
+        # chain of 4 indirections: obj#r -> s1 -> s2 -> s3 -> alice
+        store.write_relation_tuples(
+            t("n:obj#r@(n:s1#m)"),
+            t("n:s1#m@(n:s2#m)"),
+            t("n:s2#m@(n:s3#m)"),
+            t("n:s3#m@alice"),
+        )
+        _, dev = make_engines(store, mode, max_depth=10)
+        req = t("n:obj#r@alice")
+        assert not dev.subject_is_allowed(req, max_depth=3)
+        assert dev.subject_is_allowed(req, max_depth=4)
+        # depth <= 0 and depth > global clamp to global
+        assert dev.subject_is_allowed(req, max_depth=0)
+        assert dev.subject_is_allowed(req, max_depth=99)
+
+    def test_global_max_depth_precedence(self, store, mode):
+        store.write_relation_tuples(
+            t("n:obj#r@(n:s1#m)"),
+            t("n:s1#m@(n:s2#m)"),
+            t("n:s2#m@alice"),
+        )
+        _, dev = make_engines(store, mode, max_depth=2)
+        # global cap 2 < required 3: denied even when request asks for more
+        assert not dev.subject_is_allowed(t("n:obj#r@alice"), max_depth=50)
+
+    def test_subject_set_exact_match_semantics(self, store, mode):
+        # requesting the queried set itself is not auto-allowed
+        store.write_relation_tuples(t("n:obj#r@alice"))
+        _, dev = make_engines(store, mode)
+        assert not dev.subject_is_allowed(t("n:obj#r@(n:obj#r)"))
+
+    def test_unknown_everything(self, store, mode):
+        _, dev = make_engines(store, mode)
+        assert not dev.subject_is_allowed(t("no:thing#here@nobody"))
+
+    def test_write_visibility(self, store, mode):
+        _, dev = make_engines(store, mode)
+        req = t("n:obj#r@alice")
+        assert not dev.subject_is_allowed(req)
+        store.write_relation_tuples(req)
+        assert dev.subject_is_allowed(req)
+        store.delete_relation_tuples(req)
+        assert not dev.subject_is_allowed(req)
+
+    def test_batch_mixed_depths(self, store, mode):
+        store.write_relation_tuples(
+            t("n:obj#r@(n:s1#m)"),
+            t("n:s1#m@alice"),
+            t("n:obj#r@bob"),
+        )
+        _, dev = make_engines(store, mode)
+        reqs = [t("n:obj#r@alice"), t("n:obj#r@bob"), t("n:obj#r@eve")]
+        assert dev.batch_check(reqs, depths=[1, 1, 5]) == [False, True, False]
+        assert dev.batch_check(reqs, depths=[2, 1, 5]) == [True, True, False]
+
+
+def random_store(rng, n_objects, n_users, n_edges, n_rel=3):
+    """Random tuple graph with a healthy share of subject-set indirections."""
+    store = InMemoryTupleStore()
+    tuples = set()
+    for _ in range(n_edges):
+        obj = f"o{rng.integers(n_objects)}"
+        rel = f"r{rng.integers(n_rel)}"
+        if rng.random() < 0.45:
+            sub = f"n:o{rng.integers(n_objects)}#r{rng.integers(n_rel)}"
+        else:
+            sub = f"u{rng.integers(n_users)}"
+        tuples.add(f"n:{obj}#{rel}@({sub})")
+    store.write_relation_tuples(*(t(s) for s in tuples))
+    return store
+
+
+class TestDeviceMatchesOracle:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_graphs_check(self, mode, seed):
+        rng = np.random.default_rng(seed)
+        store = random_store(rng, n_objects=15, n_users=10, n_edges=120)
+        for depth in (1, 2, 3, 5, 8):
+            host, dev = make_engines(store, mode, max_depth=depth)
+            reqs = []
+            for _ in range(64):
+                obj = f"o{rng.integers(15)}"
+                rel = f"r{rng.integers(3)}"
+                if rng.random() < 0.3:
+                    sub = f"n:o{rng.integers(15)}#r{rng.integers(3)}"
+                else:
+                    sub = f"u{rng.integers(10)}"
+                reqs.append(t(f"n:{obj}#{rel}@({sub})"))
+            expect = [host.subject_is_allowed(r) for r in reqs]
+            got = dev.batch_check(reqs)
+            assert got == expect, f"seed={seed} depth={depth}"
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_graphs_expand(self, seed):
+        rng = np.random.default_rng(seed + 100)
+        store = random_store(rng, n_objects=10, n_users=8, n_edges=60)
+        mgr = SnapshotManager(store)
+        host = ExpandEngine(store, max_depth=7)
+        dev = SnapshotExpandEngine(mgr, max_depth=7)
+        for depth in (1, 2, 4, 7):
+            for o in range(10):
+                for r in range(3):
+                    subject = SubjectSet(
+                        namespace="n", object=f"o{o}", relation=f"r{r}"
+                    )
+                    ht = host.build_tree(subject, max_depth=depth)
+                    dt = dev.build_tree(subject, max_depth=depth)
+                    hd = None if ht is None else ht.to_dict()
+                    dd = None if dt is None else dt.to_dict()
+                    assert hd == dd, f"seed={seed} depth={depth} {subject}"
+
+
+class TestDistances:
+    def test_bfs_levels(self, store):
+        store.write_relation_tuples(
+            t("n:obj#r@(n:s1#m)"),
+            t("n:s1#m@(n:s2#m)"),
+            t("n:s2#m@alice"),
+        )
+        mgr = SnapshotManager(store)
+        dev = DeviceCheckEngine(mgr, max_depth=5, mode="dense")
+        snap = mgr.snapshot()
+        dist = dev.distances(
+            [SubjectSet(namespace="n", object="obj", relation="r")]
+        )[0]
+        assert dist[snap.node_for_set("n", "obj", "r")] == 0
+        assert dist[snap.node_for_set("n", "s1", "m")] == 1
+        assert dist[snap.node_for_set("n", "s2", "m")] == 2
+        assert dist[snap.node_for_subject(SubjectID(id="alice"))] == 3

@@ -1,0 +1,140 @@
+"""Graph encoding layer: vocab interning, COO/CSR encode, incremental deltas."""
+
+import numpy as np
+
+from keto_tpu.graph import GraphSnapshot, NodeVocab, SnapshotBuilder, SnapshotManager
+from keto_tpu.graph.vocab import id_key, set_key
+from keto_tpu.relationtuple import (
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+)
+from keto_tpu.store import InMemoryTupleStore
+
+
+def t(s: str) -> RelationTuple:
+    return RelationTuple.from_string(s)
+
+
+import pytest
+
+
+@pytest.fixture
+def store():
+    # no namespace validation: these tests exercise encoding, not config
+    return InMemoryTupleStore()
+
+
+class TestVocab:
+    def test_intern_stable(self):
+        v = NodeVocab()
+        a = v.intern(set_key("n", "o", "r"))
+        b = v.intern(id_key("user"))
+        assert v.intern(set_key("n", "o", "r")) == a
+        assert v.intern(id_key("user")) == b
+        assert a != b
+        assert len(v) == 2
+
+    def test_id_and_set_keys_disjoint(self):
+        v = NodeVocab()
+        # a subject id that textually looks like a set must not collide
+        a = v.intern(id_key("n:o#r"))
+        b = v.intern(set_key("n", "o", "r"))
+        assert a != b
+
+    def test_subject_roundtrip(self):
+        v = NodeVocab()
+        s1 = SubjectID(id="alice")
+        s2 = SubjectSet(namespace="files", object="readme", relation="viewer")
+        assert v.subject_of(v.intern_subject(s1)) == s1
+        assert v.subject_of(v.intern_subject(s2)) == s2
+
+
+class TestSnapshotBuilder:
+    def test_empty(self):
+        snap = SnapshotBuilder().build([], version=0)
+        assert snap.num_edges == 0
+        assert snap.padded_nodes >= 1
+        assert (snap.src == snap.dummy_node).all()
+
+    def test_edges_and_padding(self):
+        tuples = [t("n:o#r@alice"), t("n:o#r@(n:g#member)"), t("n:g#member@bob")]
+        snap = SnapshotBuilder().build(tuples, version=7)
+        assert snap.version == 7
+        assert snap.num_edges == 3
+        # power-of-two padding with dummy fill
+        assert snap.padded_edges & (snap.padded_edges - 1) == 0
+        assert (snap.src[3:] == snap.dummy_node).all()
+        # o#r has two successors: alice and the g#member set node
+        orr = snap.node_for_set("n", "o", "r")
+        succ = snap.out_neighbors(orr)
+        assert len(succ) == 2
+        keys = {snap.vocab.key(int(x)) for x in succ}
+        assert keys == {("alice",), ("n", "g", "member")}
+
+    def test_unknown_subject_maps_to_dummy(self):
+        snap = SnapshotBuilder().build([t("n:o#r@alice")], version=1)
+        assert snap.node_for_subject(SubjectID(id="nobody")) == snap.dummy_node
+        assert snap.node_for_set("n", "nope", "r") == snap.dummy_node
+
+    def test_csr_matches_coo(self):
+        rng = np.random.default_rng(0)
+        tuples = [
+            t(f"n:o{rng.integers(20)}#r@u{rng.integers(30)}") for _ in range(200)
+        ]
+        tuples = list(dict.fromkeys(tuples))
+        snap = SnapshotBuilder().build(tuples, version=1)
+        indptr, indices = snap.csr()
+        # every COO edge appears under its source's CSR row
+        for s, d in zip(snap.src[: snap.num_edges], snap.dst[: snap.num_edges]):
+            row = indices[indptr[s] : indptr[s + 1]]
+            assert d in row
+
+
+class TestSnapshotManager:
+    def test_tracks_store_version(self, store):
+        mgr = SnapshotManager(store)
+        assert mgr.snapshot().num_edges == 0
+        store.write_relation_tuples(t("n:o#r@alice"))
+        snap = mgr.snapshot()
+        assert snap.num_edges == 1
+        assert snap.version == store.version
+
+    def test_incremental_insert_keeps_node_ids(self, store):
+        store.write_relation_tuples(t("n:o#r@alice"))
+        mgr = SnapshotManager(store)
+        snap1 = mgr.snapshot()
+        nid = snap1.node_for_set("n", "o", "r")
+        store.write_relation_tuples(t("n:o#r@bob"))
+        snap2 = mgr.snapshot()
+        # applied incrementally: same vocab object, id stable, no rebuild
+        assert snap2.vocab is snap1.vocab
+        assert snap2.node_for_set("n", "o", "r") == nid
+        assert snap2.num_edges == 2
+
+    def test_delete_triggers_rebuild(self, store):
+        store.write_relation_tuples(t("n:o#r@alice"), t("n:o#r@bob"))
+        mgr = SnapshotManager(store)
+        assert mgr.snapshot().num_edges == 2
+        store.delete_relation_tuples(t("n:o#r@alice"))
+        snap = mgr.snapshot()
+        assert snap.num_edges == 1
+        orr = snap.node_for_set("n", "o", "r")
+        succ = {snap.vocab.key(int(x)) for x in snap.out_neighbors(orr)}
+        assert succ == {("bob",)}
+
+    def test_capacity_growth_rebuilds(self, store):
+        mgr = SnapshotManager(store, min_nodes=4, min_edges=4)
+        for i in range(50):
+            store.write_relation_tuples(t(f"n:o#r@user{i}"))
+        snap = mgr.snapshot()
+        assert snap.num_edges == 50
+        assert snap.padded_edges >= 64
+
+    def test_duplicate_write_is_noop_edgewise(self, store):
+        store.write_relation_tuples(t("n:o#r@alice"))
+        mgr = SnapshotManager(store)
+        store.write_relation_tuples(t("n:o#r@alice"))  # dedup in store
+        snap = mgr.snapshot()
+        assert snap.num_edges == 1
+        assert snap.version == store.version
