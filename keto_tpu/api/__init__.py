@@ -1,0 +1,39 @@
+"""Transport layer: the ory.keto.acl.v1alpha1 wire contract over gRPC + REST.
+
+Proto sources live in ``keto_tpu/api/proto`` (wire-compatible with the
+reference's published API, reference proto/ory/keto/acl/v1alpha1); generated
+message modules are committed under ``keto_tpu/api/gen`` and regenerated with::
+
+    cd keto_tpu/api && protoc --proto_path=proto --python_out=gen \
+        proto/health/health.proto proto/ory/keto/acl/v1alpha1/*.proto
+
+The gen tree is its own import root (protoc emits absolute imports), so it is
+appended to sys.path here.
+"""
+
+import os
+import sys
+
+_GEN = os.path.join(os.path.dirname(__file__), "gen")
+if _GEN not in sys.path:
+    sys.path.append(_GEN)
+
+from ory.keto.acl.v1alpha1 import (  # noqa: E402
+    acl_pb2,
+    check_service_pb2,
+    expand_service_pb2,
+    read_service_pb2,
+    version_pb2,
+    write_service_pb2,
+)
+from health import health_pb2  # noqa: E402
+
+__all__ = [
+    "acl_pb2",
+    "check_service_pb2",
+    "expand_service_pb2",
+    "read_service_pb2",
+    "version_pb2",
+    "write_service_pb2",
+    "health_pb2",
+]

@@ -1,0 +1,181 @@
+"""Port serving: read plane + write plane, each multiplexing REST and gRPC.
+
+The reference listens on two ports (read 4466 / write 4467) and uses cmux to
+split HTTP/1 REST from HTTP/2 gRPC *on the same port* (internal/driver/
+daemon.go:87-159). Python's grpc server cannot share a socket with aiohttp,
+so the same contract is met with a byte-level sniffing proxy: each public
+port accepts the TCP connection, peeks the first four bytes — every HTTP/2
+connection opens with the client preface ``PRI * HTTP/2.0`` while every
+HTTP/1 request starts with a method token — and pipes the connection to the
+loopback gRPC or REST backend accordingly. Clients see one port speaking
+both protocols, exactly like cmux.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent import futures
+from typing import Optional
+
+import grpc
+from aiohttp import web
+
+from .services import (
+    CheckServicer,
+    ExpandServicer,
+    HealthServicer,
+    ReadServicer,
+    VersionServicer,
+    WriteServicer,
+    add_check_service,
+    add_expand_service,
+    add_health_service,
+    add_read_service,
+    add_version_service,
+    add_write_service,
+)
+
+_H2_PREFACE_HEAD = b"PRI "
+
+
+class _MuxedPort:
+    """One public port -> loopback gRPC + REST backends."""
+
+    def __init__(self, host: str, port: int, grpc_port: int, http_port: int):
+        self.host = host
+        self.port = port
+        self.grpc_port = grpc_port
+        self.http_port = http_port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set[asyncio.Task] = set()
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # cancel live proxied connections: wait_closed() would block on
+            # idle keep-alive clients (3.12 waits for connection handlers)
+            for task in list(self._conns):
+                task.cancel()
+            await asyncio.gather(*self._conns, return_exceptions=True)
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conns.add(task)
+            task.add_done_callback(self._conns.discard)
+        try:
+            try:
+                head = await reader.readexactly(4)
+            except asyncio.IncompleteReadError as e:
+                head = e.partial  # short write then EOF: hand to REST side
+            if not head:
+                writer.close()
+                return
+            backend = (
+                self.grpc_port if head == _H2_PREFACE_HEAD else self.http_port
+            )
+            b_reader, b_writer = await asyncio.open_connection(
+                "127.0.0.1", backend
+            )
+        except (OSError, asyncio.IncompleteReadError):
+            writer.close()
+            return
+        b_writer.write(head)
+
+        async def pump(src: asyncio.StreamReader, dst: asyncio.StreamWriter):
+            try:
+                while True:
+                    chunk = await src.read(65536)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+                    await dst.drain()
+            except (OSError, ConnectionError):
+                pass
+            finally:
+                try:
+                    dst.close()
+                except Exception:
+                    pass
+
+        try:
+            await asyncio.gather(
+                pump(reader, b_writer), pump(b_reader, writer)
+            )
+        finally:
+            for wtr in (b_writer, writer):
+                try:
+                    wtr.close()
+                except Exception:
+                    pass
+
+
+def build_read_grpc_server(
+    checker, expand_engine, manager, snaptoken_fn, version: str,
+    health: HealthServicer, max_workers: int = 32,
+) -> grpc.Server:
+    """Read-plane gRPC: Check + Expand + Read + Version + Health (reference
+    ReadGRPCServer, registry_default.go:369-385)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    add_check_service(server, CheckServicer(checker, snaptoken_fn))
+    add_expand_service(server, ExpandServicer(expand_engine, snaptoken_fn))
+    add_read_service(server, ReadServicer(manager))
+    add_version_service(server, VersionServicer(version))
+    add_health_service(server, health)
+    return server
+
+def build_write_grpc_server(
+    manager, snaptoken_fn, version: str,
+    health: HealthServicer, max_workers: int = 32,
+) -> grpc.Server:
+    """Write-plane gRPC: Write + Version + Health (reference WriteGRPCServer,
+    registry_default.go:387-401)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    add_write_service(server, WriteServicer(manager, snaptoken_fn))
+    add_version_service(server, VersionServicer(version))
+    add_health_service(server, health)
+    return server
+
+
+class PlaneServer:
+    """One serving plane (read or write): gRPC + REST behind one muxed port."""
+
+    def __init__(
+        self, grpc_server: grpc.Server, app: web.Application,
+        host: str = "0.0.0.0", port: int = 0,
+    ):
+        self.grpc_server = grpc_server
+        self.app = app
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+        self._mux: Optional[_MuxedPort] = None
+
+    async def start(self) -> int:
+        grpc_port = self.grpc_server.add_insecure_port("127.0.0.1:0")
+        self.grpc_server.start()
+        # bounded graceful shutdown: don't wait out idle keep-alive clients
+        self._runner = web.AppRunner(self.app, shutdown_timeout=2.0)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        http_port = site._server.sockets[0].getsockname()[1]
+        self._mux = _MuxedPort(self.host, self.port, grpc_port, http_port)
+        self.port = await self._mux.start()
+        return self.port
+
+    async def stop(self, grace: float = 2.0) -> None:
+        if self._mux is not None:
+            await self._mux.stop()
+        self.grpc_server.stop(grace)
+        if self._runner is not None:
+            await self._runner.cleanup()

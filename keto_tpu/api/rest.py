@@ -1,0 +1,350 @@
+"""REST transport (aiohttp): the reference's HTTP surface, same routes and
+status-code semantics.
+
+Read port (reference RegisterReadRoutes):
+- GET  /relation-tuples               paginated query (read_server.go:114-154)
+- GET  /check, POST /check            200 {"allowed":true} / 403 {"allowed":false}
+                                      (check/handler.go:92-166)
+- GET  /expand                        subject tree or null (expand/handler.go:77-91)
+
+Write port (reference RegisterWriteRoutes):
+- PUT    /relation-tuples             create -> 201 + Location (transact_server.go:144-167)
+- DELETE /relation-tuples             delete by query -> 204 (transact_server.go:187-208)
+- PATCH  /relation-tuples             [{action: insert|delete, relation_tuple}] -> 204
+                                      (transact_server.go:238-263)
+
+Both ports: /health/alive, /health/ready (ory healthx shape), /version.
+Errors use the herodot envelope {"error": {code, status, message}}; unknown
+namespaces are 404, malformed input 400 — exactly the reference's mapping.
+Subjects arrive either as `subject_id` or dotted `subject_set.*` query
+params; supplying both (or neither, where one is required) is a 400
+(transact_server.go:89-123 swagger params).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from ..relationtuple.definitions import (
+    RelationQuery,
+    RelationTuple,
+    Subject,
+    SubjectID,
+    SubjectSet,
+)
+from ..utils.errors import ErrMalformedInput, KetoError
+from ..utils.pagination import PaginationOptions
+
+ROUTE_TUPLES = "/relation-tuples"
+ROUTE_CHECK = "/check"
+ROUTE_EXPAND = "/expand"
+
+
+def _json_error(err: KetoError) -> web.Response:
+    return web.json_response(err.envelope(), status=err.status_code)
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    try:
+        return await handler(request)
+    except KetoError as e:
+        return _json_error(e)
+    except web.HTTPException:
+        raise
+    except Exception as e:  # internal
+        return web.json_response(
+            {
+                "error": {
+                    "code": 500,
+                    "status": "Internal Server Error",
+                    "message": str(e),
+                }
+            },
+            status=500,
+        )
+
+
+def make_cors_middleware(cfg: Optional[dict]):
+    """Minimal CORS handling driven by the serve.*.cors config subtree
+    (reference uses rs/cors with the same option names)."""
+    cfg = cfg or {}
+    enabled = cfg.get("enabled", False)
+    allowed_origins = cfg.get("allowed_origins", ["*"])
+    allowed_methods = cfg.get(
+        "allowed_methods", ["GET", "POST", "PUT", "PATCH", "DELETE"]
+    )
+    allowed_headers = cfg.get("allowed_headers", ["Authorization", "Content-Type"])
+
+    @web.middleware
+    async def cors_middleware(request: web.Request, handler):
+        origin = request.headers.get("Origin")
+        if not enabled or not origin:
+            if request.method == "OPTIONS":
+                return web.Response(status=204)
+            return await handler(request)
+        ok = "*" in allowed_origins or origin in allowed_origins
+        if request.method == "OPTIONS":
+            resp = web.Response(status=204)
+        else:
+            resp = await handler(request)
+        if ok:
+            resp.headers["Access-Control-Allow-Origin"] = origin
+            resp.headers["Access-Control-Allow-Methods"] = ", ".join(
+                allowed_methods
+            )
+            resp.headers["Access-Control-Allow-Headers"] = ", ".join(
+                allowed_headers
+            )
+        return resp
+
+    return cors_middleware
+
+
+def subject_from_query(params, required: bool) -> Optional[Subject]:
+    """subject_id XOR subject_set.{namespace,object,relation} (reference
+    transact_server.go:89-123; exactly-one enforced like the SQL CHECK)."""
+    sid = params.get("subject_id")
+    sns = params.get("subject_set.namespace")
+    sobj = params.get("subject_set.object")
+    srel = params.get("subject_set.relation")
+    has_set = sns is not None or sobj is not None or srel is not None
+    if sid is not None and has_set:
+        raise ErrMalformedInput(
+            "exactly one of subject_id or subject_set.* is allowed"
+        )
+    if sid is not None:
+        return SubjectID(id=sid)
+    if has_set:
+        if sns is None or sobj is None or srel is None:
+            raise ErrMalformedInput(
+                "subject_set requires namespace, object, and relation"
+            )
+        return SubjectSet(namespace=sns, object=sobj, relation=srel)
+    if required:
+        raise ErrMalformedInput(
+            "either subject_id or subject_set.* is required"
+        )
+    return None
+
+
+def max_depth_from_query(params) -> int:
+    raw = params.get("max-depth", "0")
+    try:
+        return int(raw)
+    except ValueError:
+        raise ErrMalformedInput(f"max-depth must be an integer, got {raw!r}") from None
+
+
+def _tuple_from_query(params) -> RelationTuple:
+    for key in ("namespace", "object", "relation"):
+        if params.get(key) is None:
+            raise ErrMalformedInput(f"missing query parameter {key}")
+    return RelationTuple(
+        namespace=params["namespace"],
+        object=params["object"],
+        relation=params["relation"],
+        subject=subject_from_query(params, required=True),
+    )
+
+
+async def _json_body(request: web.Request):
+    try:
+        return json.loads(await request.text())
+    except json.JSONDecodeError as e:
+        raise ErrMalformedInput(f"invalid json body: {e}") from None
+
+
+class ReadAPI:
+    def __init__(self, manager, checker, expand_engine, snaptoken_fn):
+        self.manager = manager
+        self.checker = checker
+        self.expand_engine = expand_engine
+        self.snaptoken_fn = snaptoken_fn
+
+    def register(self, app: web.Application) -> None:
+        app.router.add_get(ROUTE_TUPLES, self.get_relations)
+        app.router.add_get(ROUTE_CHECK, self.get_check)
+        app.router.add_post(ROUTE_CHECK, self.post_check)
+        app.router.add_get(ROUTE_EXPAND, self.get_expand)
+
+    async def get_relations(self, request: web.Request) -> web.Response:
+        p = request.rel_url.query
+        query = RelationQuery(
+            namespace=p.get("namespace"),
+            object=p.get("object"),
+            relation=p.get("relation"),
+            subject=subject_from_query(p, required=False),
+        )
+        try:
+            size = int(p.get("page_size", "0"))
+        except ValueError:
+            raise ErrMalformedInput("page_size must be an integer") from None
+        tuples, next_token = self.manager.get_relation_tuples(
+            query, PaginationOptions(token=p.get("page_token", ""), size=size)
+        )
+        return web.json_response(
+            {
+                "relation_tuples": [t.to_dict() for t in tuples],
+                "next_page_token": next_token,
+            }
+        )
+
+    async def get_check(self, request: web.Request) -> web.Response:
+        p = request.rel_url.query
+        tup = _tuple_from_query(p)
+        return await self._check_response(tup, max_depth_from_query(p))
+
+    async def post_check(self, request: web.Request) -> web.Response:
+        body = await _json_body(request)
+        tup = RelationTuple.from_dict(body)
+        return await self._check_response(
+            tup, max_depth_from_query(request.rel_url.query)
+        )
+
+    async def _check_response(
+        self, tup: RelationTuple, max_depth: int
+    ) -> web.Response:
+        # the check blocks on device compute (or the batcher window) — run it
+        # off the event loop so concurrent requests accumulate into batches
+        allowed = await asyncio.get_running_loop().run_in_executor(
+            None, self.checker.check, tup, max_depth
+        )
+        # 200 when allowed, 403 when denied — both carry the body
+        # (reference check/handler.go:120-139)
+        return web.json_response(
+            {"allowed": allowed}, status=200 if allowed else 403
+        )
+
+    async def get_expand(self, request: web.Request) -> web.Response:
+        p = request.rel_url.query
+        for key in ("namespace", "object", "relation"):
+            if p.get(key) is None:
+                raise ErrMalformedInput(f"missing query parameter {key}")
+        subject = SubjectSet(
+            namespace=p["namespace"], object=p["object"], relation=p["relation"]
+        )
+        depth = max_depth_from_query(p)
+        tree = await asyncio.get_running_loop().run_in_executor(
+            None, self.expand_engine.build_tree, subject, depth
+        )
+        # nil tree serializes as null with 200, like the reference's
+        # herodot Write of a nil pointer (expand/handler.go:90)
+        return web.json_response(None if tree is None else tree.to_dict())
+
+
+class WriteAPI:
+    def __init__(self, manager, snaptoken_fn):
+        self.manager = manager
+        self.snaptoken_fn = snaptoken_fn
+
+    def register(self, app: web.Application) -> None:
+        app.router.add_put(ROUTE_TUPLES, self.create_relation)
+        app.router.add_delete(ROUTE_TUPLES, self.delete_relations)
+        app.router.add_patch(ROUTE_TUPLES, self.patch_relations)
+
+    async def create_relation(self, request: web.Request) -> web.Response:
+        body = await _json_body(request)
+        tup = RelationTuple.from_dict(body)
+        self.manager.write_relation_tuples(tup)
+        location = ROUTE_TUPLES + "?" + _tuple_location_query(tup)
+        return web.json_response(
+            tup.to_dict(), status=201, headers={"Location": location}
+        )
+
+    async def delete_relations(self, request: web.Request) -> web.Response:
+        p = request.rel_url.query
+        query = RelationQuery(
+            namespace=p.get("namespace"),
+            object=p.get("object"),
+            relation=p.get("relation"),
+            subject=subject_from_query(p, required=False),
+        )
+        self.manager.delete_all_relation_tuples(query)
+        return web.Response(status=204)
+
+    async def patch_relations(self, request: web.Request) -> web.Response:
+        body = await _json_body(request)
+        if not isinstance(body, list):
+            raise ErrMalformedInput("expected a json array of deltas")
+        inserts: list[RelationTuple] = []
+        deletes: list[RelationTuple] = []
+        for delta in body:
+            if not isinstance(delta, dict):
+                raise ErrMalformedInput("expected delta object")
+            action = delta.get("action")
+            tup = RelationTuple.from_dict(delta.get("relation_tuple") or {})
+            if action == "insert":
+                inserts.append(tup)
+            elif action == "delete":
+                deletes.append(tup)
+            else:
+                # unknown action is a 400, nothing applied
+                # (transact_server.go:250-255)
+                raise ErrMalformedInput(f"unknown action {action!r}")
+        self.manager.transact_relation_tuples(inserts, deletes)
+        return web.Response(status=204)
+
+
+def _tuple_location_query(t: RelationTuple) -> str:
+    from urllib.parse import urlencode
+
+    q = {"namespace": t.namespace, "object": t.object, "relation": t.relation}
+    if isinstance(t.subject, SubjectID):
+        q["subject_id"] = t.subject.id
+    else:
+        q["subject_set.namespace"] = t.subject.namespace
+        q["subject_set.object"] = t.subject.object
+        q["subject_set.relation"] = t.subject.relation
+    return urlencode(q)
+
+
+def register_common(app: web.Application, version: str, healthy_fn=None) -> None:
+    """/health/alive, /health/ready, /version on both ports (reference
+    healthx + version handler, registry_default.go:98-116)."""
+
+    async def alive(_request):
+        return web.json_response({"status": "ok"})
+
+    async def ready(_request):
+        if healthy_fn is not None and not healthy_fn():
+            return web.json_response(
+                {"errors": {"server": "not ready"}}, status=503
+            )
+        return web.json_response({"status": "ok"})
+
+    async def get_version(_request):
+        return web.json_response({"version": version})
+
+    app.router.add_get("/health/alive", alive)
+    app.router.add_get("/health/ready", ready)
+    app.router.add_get("/version", get_version)
+
+
+def build_read_app(
+    manager, checker, expand_engine, snaptoken_fn, version: str,
+    cors: Optional[dict] = None, healthy_fn=None,
+) -> web.Application:
+    # CORS outermost so error responses also carry the headers
+    app = web.Application(
+        middlewares=[make_cors_middleware(cors), error_middleware]
+    )
+    ReadAPI(manager, checker, expand_engine, snaptoken_fn).register(app)
+    register_common(app, version, healthy_fn)
+    return app
+
+
+def build_write_app(
+    manager, snaptoken_fn, version: str,
+    cors: Optional[dict] = None, healthy_fn=None,
+) -> web.Application:
+    app = web.Application(
+        middlewares=[make_cors_middleware(cors), error_middleware]
+    )
+    WriteAPI(manager, snaptoken_fn).register(app)
+    register_common(app, version, healthy_fn)
+    return app

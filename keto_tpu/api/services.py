@@ -1,0 +1,398 @@
+"""gRPC services implementing the ory.keto.acl.v1alpha1 contract.
+
+Servicers mirror the reference's gRPC handlers (CheckService
+internal/check/handler.go:168-184, ExpandService internal/expand/
+handler.go:93-104, Read/Write services internal/relationtuple/
+{read,transact}_server.go, VersionService internal/driver/registry_default.go)
+plus the standard grpc.health.v1 protocol both ports expose.
+
+Service wiring and client stubs are written out by hand (the runtime image
+ships no grpc_tools plugin); they register the same fully-qualified method
+names the reference serves, so any Keto gRPC client interoperates.
+
+One deliberate upgrade: snaptokens are real here. The reference answers
+`snaptoken: "not yet implemented"`; we return the store version the answer
+was computed at.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterator, Optional
+
+import grpc
+
+from ..relationtuple.definitions import RelationQuery, RelationTuple
+from ..utils.errors import ErrMalformedInput, KetoError
+from ..utils.pagination import PaginationOptions
+from . import (
+    acl_pb2,
+    check_service_pb2,
+    expand_service_pb2,
+    health_pb2,
+    read_service_pb2,
+    version_pb2,
+    write_service_pb2,
+)
+from .convert import (
+    query_from_proto_fields,
+    subject_from_proto,
+    tree_to_proto,
+    tuple_from_proto,
+    tuple_to_proto,
+)
+
+_PKG = "ory.keto.acl.v1alpha1"
+
+
+def _abort(context: grpc.ServicerContext, err: Exception):
+    if isinstance(err, KetoError):
+        code = getattr(grpc.StatusCode, err.grpc_code, grpc.StatusCode.INTERNAL)
+        context.abort(code, err.message)
+    context.abort(grpc.StatusCode.INTERNAL, str(err))
+
+
+class CheckServicer:
+    """`checker` is anything with check(tuple, max_depth) -> bool (a
+    CheckBatcher or a _DirectChecker); snaptoken_fn yields the current store
+    version."""
+
+    def __init__(self, checker, snaptoken_fn: Callable[[], str]):
+        self.checker = checker
+        self.snaptoken_fn = snaptoken_fn
+
+    def Check(self, request, context):
+        try:
+            subject = subject_from_proto(
+                request.subject if request.HasField("subject") else None
+            )
+            if subject is None:
+                raise ErrMalformedInput("check request without subject")
+            tup = RelationTuple(
+                namespace=request.namespace,
+                object=request.object,
+                relation=request.relation,
+                subject=subject,
+            )
+            allowed = self.checker.check(tup, request.max_depth)
+            return check_service_pb2.CheckResponse(
+                allowed=allowed, snaptoken=self.snaptoken_fn()
+            )
+        except Exception as e:
+            _abort(context, e)
+
+
+class ExpandServicer:
+    def __init__(self, expand_engine, snaptoken_fn: Callable[[], str]):
+        self.expand_engine = expand_engine
+        self.snaptoken_fn = snaptoken_fn
+
+    def Expand(self, request, context):
+        try:
+            subject = subject_from_proto(
+                request.subject if request.HasField("subject") else None
+            )
+            if subject is None:
+                raise ErrMalformedInput("expand request without subject")
+            tree = self.expand_engine.build_tree(subject, request.max_depth)
+            proto_tree = tree_to_proto(tree)
+            if proto_tree is None:
+                return expand_service_pb2.ExpandResponse()
+            return expand_service_pb2.ExpandResponse(tree=proto_tree)
+        except Exception as e:
+            _abort(context, e)
+
+
+class ReadServicer:
+    def __init__(self, manager):
+        self.manager = manager
+
+    def ListRelationTuples(self, request, context):
+        try:
+            q = request.query
+            query = query_from_proto_fields(
+                q.namespace,
+                q.object,
+                q.relation,
+                q.subject if q.HasField("subject") else None,
+            )
+            tuples, next_token = self.manager.get_relation_tuples(
+                query,
+                PaginationOptions(
+                    token=request.page_token, size=request.page_size
+                ),
+            )
+            return read_service_pb2.ListRelationTuplesResponse(
+                relation_tuples=[tuple_to_proto(t) for t in tuples],
+                next_page_token=next_token,
+            )
+        except Exception as e:
+            _abort(context, e)
+
+
+class WriteServicer:
+    def __init__(self, manager, snaptoken_fn: Callable[[], str]):
+        self.manager = manager
+        self.snaptoken_fn = snaptoken_fn
+
+    def TransactRelationTuples(self, request, context):
+        try:
+            inserts: list[RelationTuple] = []
+            deletes: list[RelationTuple] = []
+            for delta in request.relation_tuple_deltas:
+                tup = tuple_from_proto(delta.relation_tuple)
+                if delta.action == write_service_pb2.RelationTupleDelta.INSERT:
+                    inserts.append(tup)
+                elif delta.action == write_service_pb2.RelationTupleDelta.DELETE:
+                    deletes.append(tup)
+                else:
+                    raise ErrMalformedInput(
+                        f"unspecified delta action for {tup}"
+                    )
+            self.manager.transact_relation_tuples(inserts, deletes)
+            token = self.snaptoken_fn()
+            return write_service_pb2.TransactRelationTuplesResponse(
+                snaptokens=[token] * len(request.relation_tuple_deltas)
+            )
+        except Exception as e:
+            _abort(context, e)
+
+    def DeleteRelationTuples(self, request, context):
+        try:
+            q = request.query
+            query = query_from_proto_fields(
+                q.namespace,
+                q.object,
+                q.relation,
+                q.subject if q.HasField("subject") else None,
+            )
+            self.manager.delete_all_relation_tuples(query)
+            return write_service_pb2.DeleteRelationTuplesResponse()
+        except Exception as e:
+            _abort(context, e)
+
+
+class VersionServicer:
+    def __init__(self, version: str):
+        self.version = version
+
+    def GetVersion(self, request, context):
+        return version_pb2.GetVersionResponse(version=self.version)
+
+
+class HealthServicer:
+    """grpc.health.v1 with Watch support (reference `keto status --block`
+    watches until SERVING, cmd/status/root.go:70-101)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._status = health_pb2.HealthCheckResponse.SERVING
+
+    def set_status(self, status) -> None:
+        with self._cv:
+            self._status = status
+            self._cv.notify_all()
+
+    def Check(self, request, context):
+        with self._lock:
+            return health_pb2.HealthCheckResponse(status=self._status)
+
+    def Watch(self, request, context) -> Iterator:
+        last = None
+        while context.is_active():
+            with self._cv:
+                if self._status == last:
+                    self._cv.wait(timeout=1.0)
+                status = self._status
+            if status != last:
+                last = status
+                yield health_pb2.HealthCheckResponse(status=status)
+
+
+# -- server wiring (what protoc's grpc plugin would have generated) -----------
+
+
+def _unary(fn, req_cls, resp_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString,
+    )
+
+
+def add_check_service(server, servicer: CheckServicer):
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            f"{_PKG}.CheckService",
+            {
+                "Check": _unary(
+                    servicer.Check,
+                    check_service_pb2.CheckRequest,
+                    check_service_pb2.CheckResponse,
+                )
+            },
+        ),
+    ))
+
+
+def add_expand_service(server, servicer: ExpandServicer):
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            f"{_PKG}.ExpandService",
+            {
+                "Expand": _unary(
+                    servicer.Expand,
+                    expand_service_pb2.ExpandRequest,
+                    expand_service_pb2.ExpandResponse,
+                )
+            },
+        ),
+    ))
+
+
+def add_read_service(server, servicer: ReadServicer):
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            f"{_PKG}.ReadService",
+            {
+                "ListRelationTuples": _unary(
+                    servicer.ListRelationTuples,
+                    read_service_pb2.ListRelationTuplesRequest,
+                    read_service_pb2.ListRelationTuplesResponse,
+                )
+            },
+        ),
+    ))
+
+
+def add_write_service(server, servicer: WriteServicer):
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            f"{_PKG}.WriteService",
+            {
+                "TransactRelationTuples": _unary(
+                    servicer.TransactRelationTuples,
+                    write_service_pb2.TransactRelationTuplesRequest,
+                    write_service_pb2.TransactRelationTuplesResponse,
+                ),
+                "DeleteRelationTuples": _unary(
+                    servicer.DeleteRelationTuples,
+                    write_service_pb2.DeleteRelationTuplesRequest,
+                    write_service_pb2.DeleteRelationTuplesResponse,
+                ),
+            },
+        ),
+    ))
+
+
+def add_version_service(server, servicer: VersionServicer):
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            f"{_PKG}.VersionService",
+            {
+                "GetVersion": _unary(
+                    servicer.GetVersion,
+                    version_pb2.GetVersionRequest,
+                    version_pb2.GetVersionResponse,
+                )
+            },
+        ),
+    ))
+
+
+def add_health_service(server, servicer: HealthServicer):
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            "grpc.health.v1.Health",
+            {
+                "Check": _unary(
+                    servicer.Check,
+                    health_pb2.HealthCheckRequest,
+                    health_pb2.HealthCheckResponse,
+                ),
+                "Watch": grpc.unary_stream_rpc_method_handler(
+                    servicer.Watch,
+                    request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                    response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+                ),
+            },
+        ),
+    ))
+
+
+# -- client stubs -------------------------------------------------------------
+
+
+class CheckServiceStub:
+    def __init__(self, channel: grpc.Channel):
+        self.Check = channel.unary_unary(
+            f"/{_PKG}.CheckService/Check",
+            request_serializer=check_service_pb2.CheckRequest.SerializeToString,
+            response_deserializer=check_service_pb2.CheckResponse.FromString,
+        )
+
+
+class ExpandServiceStub:
+    def __init__(self, channel: grpc.Channel):
+        self.Expand = channel.unary_unary(
+            f"/{_PKG}.ExpandService/Expand",
+            request_serializer=expand_service_pb2.ExpandRequest.SerializeToString,
+            response_deserializer=expand_service_pb2.ExpandResponse.FromString,
+        )
+
+
+class ReadServiceStub:
+    def __init__(self, channel: grpc.Channel):
+        self.ListRelationTuples = channel.unary_unary(
+            f"/{_PKG}.ReadService/ListRelationTuples",
+            request_serializer=read_service_pb2.ListRelationTuplesRequest.SerializeToString,
+            response_deserializer=read_service_pb2.ListRelationTuplesResponse.FromString,
+        )
+
+
+class WriteServiceStub:
+    def __init__(self, channel: grpc.Channel):
+        self.TransactRelationTuples = channel.unary_unary(
+            f"/{_PKG}.WriteService/TransactRelationTuples",
+            request_serializer=write_service_pb2.TransactRelationTuplesRequest.SerializeToString,
+            response_deserializer=write_service_pb2.TransactRelationTuplesResponse.FromString,
+        )
+        self.DeleteRelationTuples = channel.unary_unary(
+            f"/{_PKG}.WriteService/DeleteRelationTuples",
+            request_serializer=write_service_pb2.DeleteRelationTuplesRequest.SerializeToString,
+            response_deserializer=write_service_pb2.DeleteRelationTuplesResponse.FromString,
+        )
+
+
+class VersionServiceStub:
+    def __init__(self, channel: grpc.Channel):
+        self.GetVersion = channel.unary_unary(
+            f"/{_PKG}.VersionService/GetVersion",
+            request_serializer=version_pb2.GetVersionRequest.SerializeToString,
+            response_deserializer=version_pb2.GetVersionResponse.FromString,
+        )
+
+
+class HealthStub:
+    def __init__(self, channel: grpc.Channel):
+        self.Check = channel.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        self.Watch = channel.unary_stream(
+            "/grpc.health.v1.Health/Watch",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+
+
+class _DirectChecker:
+    """Unbatched adapter: checker interface over a bare engine."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def check(self, request: RelationTuple, max_depth: int = 0) -> bool:
+        return self.engine.subject_is_allowed(request, max_depth)

@@ -1,0 +1,6 @@
+"""Driver: config provider + DI registry + serving (reference internal/driver)."""
+
+from .config import Config
+from .registry import Registry
+
+__all__ = ["Config", "Registry"]

@@ -1,0 +1,254 @@
+"""Config provider: schema-validated, file + env + overrides, hot-reloadable
+namespaces.
+
+Mirrors the reference's configx-based provider (internal/driver/config/
+provider.go, config.schema.json): same key tree — ``dsn``,
+``serve.read.{host,port,cors,max-depth}``, ``serve.write.{...}``, ``log``,
+``tracing``, ``namespaces`` (inline array of {id,name} or a file/dir URI) —
+plus a ``keto_tpu``-specific ``engine`` subtree controlling the device
+evaluation path (mode, dense threshold, batching). DSN and serve keys are
+treated as immutable after boot, like the reference (provider.go:70).
+
+Env overrides use the same flattening configx applies: ``serve.read.port`` ->
+``SERVE_READ_PORT`` (dots and dashes to underscores, uppercased), optionally
+prefixed ``KETO_``. Values parse as JSON when possible (ints, bools), else
+strings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jsonschema
+
+from ..namespace.definitions import MemoryNamespaceManager, Namespace, NamespaceManager
+from ..utils.errors import ErrMalformedInput
+from ..utils.fileformat import load_structured_file
+
+KEY_DSN = "dsn"
+KEY_READ_PORT = "serve.read.port"
+KEY_READ_HOST = "serve.read.host"
+KEY_WRITE_PORT = "serve.write.port"
+KEY_WRITE_HOST = "serve.write.host"
+KEY_READ_MAX_DEPTH = "serve.read.max-depth"  # reference provider.go:32
+KEY_NAMESPACES = "namespaces"
+
+_CORS_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "enabled": {"type": "boolean", "default": False},
+        "allowed_origins": {"type": "array", "items": {"type": "string"}},
+        "allowed_methods": {"type": "array", "items": {"type": "string"}},
+        "allowed_headers": {"type": "array", "items": {"type": "string"}},
+    },
+    "additionalProperties": True,
+}
+
+_PORT_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "port": {"type": "integer"},
+        "host": {"type": "string"},
+        "cors": _CORS_SCHEMA,
+        "max-depth": {"type": "integer", "minimum": 1},
+    },
+    "additionalProperties": True,
+}
+
+# The same surface as the reference's config.schema.json (380 lines there;
+# condensed here), extended with the engine subtree.
+CONFIG_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "dsn": {"type": "string"},
+        "serve": {
+            "type": "object",
+            "properties": {"read": _PORT_SCHEMA, "write": _PORT_SCHEMA},
+            "additionalProperties": False,
+        },
+        "log": {
+            "type": "object",
+            "properties": {
+                "level": {
+                    "enum": ["trace", "debug", "info", "warn", "error", "fatal"]
+                },
+                "format": {"enum": ["json", "text"]},
+            },
+            "additionalProperties": True,
+        },
+        "tracing": {"type": "object"},
+        "profiling": {"type": "string"},
+        "namespaces": {
+            "oneOf": [
+                {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "properties": {
+                            "id": {"type": "integer"},
+                            "name": {"type": "string"},
+                        },
+                        "required": ["name"],
+                        "additionalProperties": True,
+                    },
+                },
+                {"type": "string"},
+            ]
+        },
+        "engine": {
+            "type": "object",
+            "properties": {
+                "mode": {"enum": ["device", "host", "auto"]},
+                "dense_threshold": {"type": "integer", "minimum": 2},
+                "max_batch": {"type": "integer", "minimum": 1},
+                "batch_window_us": {"type": "number", "minimum": 0},
+            },
+            "additionalProperties": False,
+        },
+    },
+    "additionalProperties": False,
+}
+
+DEFAULTS = {
+    "dsn": "memory",
+    "serve.read.port": 4466,
+    "serve.read.host": "",
+    "serve.read.max-depth": 5,
+    "serve.write.port": 4467,
+    "serve.write.host": "",
+    "log.level": "info",
+    "namespaces": [],
+    "engine.mode": "device",
+    "engine.dense_threshold": 8192,
+    "engine.max_batch": 4096,
+    "engine.batch_window_us": 200,
+}
+
+
+def _flatten_env_key(key: str) -> str:
+    return key.replace(".", "_").replace("-", "_").upper()
+
+
+def _parse_env_value(raw: str) -> Any:
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return raw
+
+
+def load_config_file(path: str) -> dict:
+    data = load_structured_file(path)
+    if data is None:
+        data = {}
+    if not isinstance(data, dict):
+        raise ErrMalformedInput(f"config root must be a mapping: {path}")
+    return data
+
+
+class Config:
+    def __init__(
+        self,
+        values: Optional[dict] = None,
+        config_file: Optional[str] = None,
+        env: Optional[dict] = None,
+        flag_overrides: Optional[dict[str, Any]] = None,
+    ):
+        data: dict = {}
+        if config_file:
+            data = load_config_file(config_file)
+        if values:
+            data = _deep_merge(data, values)
+        self._data = data
+        self._env = dict(env if env is not None else os.environ)
+        self._overrides: dict[str, Any] = dict(flag_overrides or {})
+        self.validate()
+        self._namespace_manager: Optional[NamespaceManager] = None
+
+    def validate(self) -> None:
+        try:
+            jsonschema.validate(self._data, CONFIG_SCHEMA)
+        except jsonschema.ValidationError as e:
+            raise ErrMalformedInput(
+                f"invalid configuration: {e.message} (at {'/'.join(map(str, e.path))})"
+            ) from e
+
+    # -- lookup ---------------------------------------------------------------
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._overrides:
+            return self._overrides[key]
+        env_val = self._env.get("KETO_" + _flatten_env_key(key))
+        if env_val is None:
+            env_val = self._env.get(_flatten_env_key(key))
+        if env_val is not None:
+            return _parse_env_value(env_val)
+        node: Any = self._data
+        for part in key.split("."):
+            if not isinstance(node, dict) or part not in node:
+                if default is not None:
+                    return default
+                return DEFAULTS.get(key)
+            node = node[part]
+        return node
+
+    def set_override(self, key: str, value: Any) -> None:
+        self._overrides[key] = value
+
+    # -- typed accessors (reference provider.go) ------------------------------
+
+    def dsn(self) -> str:
+        return self.get(KEY_DSN)
+
+    def read_api_host(self) -> str:
+        return self.get(KEY_READ_HOST) or "0.0.0.0"
+
+    def read_api_port(self) -> int:
+        return int(self.get(KEY_READ_PORT))
+
+    def write_api_host(self) -> str:
+        return self.get(KEY_WRITE_HOST) or "0.0.0.0"
+
+    def write_api_port(self) -> int:
+        return int(self.get(KEY_WRITE_PORT))
+
+    def read_api_max_depth(self) -> int:
+        return int(self.get(KEY_READ_MAX_DEPTH))
+
+    def cors(self, plane: str) -> Optional[dict]:
+        return self.get(f"serve.{plane}.cors", default={}) or None
+
+    def engine_mode(self) -> str:
+        return self.get("engine.mode")
+
+    def namespace_manager(self) -> NamespaceManager:
+        """Inline array -> memory manager; string URI -> file/dir watcher with
+        hot reload (reference provider.go:190-218 dispatch)."""
+        if self._namespace_manager is None:
+            spec = self.get(KEY_NAMESPACES)
+            if isinstance(spec, str):
+                from ..namespace.watcher import NamespaceWatcher
+
+                self._namespace_manager = NamespaceWatcher(spec)
+            else:
+                nss = [
+                    Namespace(
+                        name=n["name"],
+                        id=int(n.get("id", 0)),
+                        config=n.get("config", {}) or {},
+                    )
+                    for n in (spec or [])
+                ]
+                self._namespace_manager = MemoryNamespaceManager(*nss)
+        return self._namespace_manager
+
+
+def _deep_merge(base: dict, extra: dict) -> dict:
+    out = dict(base)
+    for k, v in extra.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

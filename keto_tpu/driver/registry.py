@@ -1,0 +1,214 @@
+"""Registry: the dependency-injection spine (reference driver.Registry,
+internal/driver/registry.go:26-58 / registry_default.go).
+
+Lazily builds and wires: config -> namespace manager -> tuple store (by DSN)
+-> graph snapshot manager -> device/host engines -> batcher -> servicers ->
+REST apps -> muxed plane servers. ``serve_all`` runs both planes (reference
+daemon.go:62-69 ServeAll).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from .. import __version__
+from ..api.daemon import (
+    PlaneServer,
+    build_read_grpc_server,
+    build_write_grpc_server,
+)
+from ..api.rest import build_read_app, build_write_app
+from ..api.services import HealthServicer, _DirectChecker
+from ..engine.batcher import CheckBatcher
+from ..engine.check import CheckEngine
+from ..engine.device import DeviceCheckEngine, SnapshotExpandEngine
+from ..engine.expand import ExpandEngine
+from ..graph.snapshot import SnapshotManager
+from ..store.memory import InMemoryTupleStore
+from ..utils.errors import ErrMalformedInput
+from .config import Config
+
+
+class Registry:
+    def __init__(self, config: Optional[Config] = None):
+        self.config = config or Config()
+        self._namespace_manager = None
+        self._store = None
+        self._snapshots = None
+        self._check_engine = None
+        self._expand_engine = None
+        self._batcher = None
+        self._checker = None
+        self.health = HealthServicer()
+        self.version = __version__
+        self._read_plane: Optional[PlaneServer] = None
+        self._write_plane: Optional[PlaneServer] = None
+
+    # -- providers (lazy, like RegistryDefault's memoized getters) ------------
+
+    def namespace_manager(self):
+        if self._namespace_manager is None:
+            self._namespace_manager = self.config.namespace_manager()
+        return self._namespace_manager
+
+    def store(self):
+        if self._store is None:
+            dsn = self.config.dsn()
+            if dsn in ("memory", "sqlite://:memory:", ""):
+                self._store = InMemoryTupleStore(
+                    namespace_manager=self.namespace_manager()
+                )
+            elif dsn.startswith("sqlite://"):
+                try:
+                    from ..persistence.sqlite import SQLiteTupleStore
+                except ImportError as e:
+                    raise ErrMalformedInput(
+                        "sqlite persistence is not available in this build"
+                    ) from e
+                self._store = SQLiteTupleStore(
+                    dsn[len("sqlite://"):],
+                    namespace_manager=self.namespace_manager(),
+                )
+            else:
+                raise ErrMalformedInput(
+                    f"unsupported DSN {dsn!r}: this build supports 'memory' "
+                    "and 'sqlite://<path>' (postgres/mysql/cockroach drivers "
+                    "are not present in the runtime image)"
+                )
+        return self._store
+
+    def snapshots(self) -> SnapshotManager:
+        if self._snapshots is None:
+            self._snapshots = SnapshotManager(self.store())
+        return self._snapshots
+
+    def check_engine(self):
+        if self._check_engine is None:
+            max_depth = self.config.read_api_max_depth()
+            if self.config.engine_mode() == "host":
+                self._check_engine = CheckEngine(self.store(), max_depth=max_depth)
+            else:
+                self._check_engine = DeviceCheckEngine(
+                    self.snapshots(),
+                    max_depth=max_depth,
+                    mode="auto",
+                    dense_threshold=int(
+                        self.config.get("engine.dense_threshold")
+                    ),
+                )
+        return self._check_engine
+
+    def expand_engine(self):
+        if self._expand_engine is None:
+            max_depth = self.config.read_api_max_depth()
+            if self.config.engine_mode() == "host":
+                self._expand_engine = ExpandEngine(
+                    self.store(), max_depth=max_depth
+                )
+            else:
+                self._expand_engine = SnapshotExpandEngine(
+                    self.snapshots(), max_depth=max_depth
+                )
+        return self._expand_engine
+
+    def checker(self):
+        """The check entry point handlers use: batched on the device path,
+        direct on the host path."""
+        if self._checker is None:
+            engine = self.check_engine()
+            if isinstance(engine, DeviceCheckEngine):
+                self._batcher = CheckBatcher(
+                    engine,
+                    max_batch=int(self.config.get("engine.max_batch")),
+                    window_s=float(self.config.get("engine.batch_window_us"))
+                    / 1e6,
+                )
+                self._checker = self._batcher
+            else:
+                self._checker = _DirectChecker(engine)
+        return self._checker
+
+    def snaptoken(self) -> str:
+        return str(self.store().version)
+
+    # -- serving ---------------------------------------------------------------
+
+    def read_plane(self) -> PlaneServer:
+        if self._read_plane is None:
+            grpc_server = build_read_grpc_server(
+                self.checker(),
+                self.expand_engine(),
+                self.store(),
+                self.snaptoken,
+                self.version,
+                self.health,
+            )
+            app = build_read_app(
+                self.store(),
+                self.checker(),
+                self.expand_engine(),
+                self.snaptoken,
+                self.version,
+                cors=self.config.cors("read"),
+            )
+            self._read_plane = PlaneServer(
+                grpc_server,
+                app,
+                host=self.config.read_api_host(),
+                port=self.config.read_api_port(),
+            )
+        return self._read_plane
+
+    def write_plane(self) -> PlaneServer:
+        if self._write_plane is None:
+            grpc_server = build_write_grpc_server(
+                self.store(), self.snaptoken, self.version, self.health
+            )
+            app = build_write_app(
+                self.store(),
+                self.snaptoken,
+                self.version,
+                cors=self.config.cors("write"),
+            )
+            self._write_plane = PlaneServer(
+                grpc_server,
+                app,
+                host=self.config.write_api_host(),
+                port=self.config.write_api_port(),
+            )
+        return self._write_plane
+
+    async def start_all(self) -> tuple[int, int]:
+        """Start both planes; returns (read_port, write_port). Pre-warms the
+        device kernel so the first request doesn't pay XLA compile latency."""
+        engine = self.check_engine()
+        if hasattr(engine, "warmup"):
+            await asyncio.get_running_loop().run_in_executor(
+                None, engine.warmup
+            )
+        read_port = await self.read_plane().start()
+        write_port = await self.write_plane().start()
+        return read_port, write_port
+
+    async def stop_all(self) -> None:
+        if self._read_plane is not None:
+            await self._read_plane.stop()
+        if self._write_plane is not None:
+            await self._write_plane.stop()
+        if self._batcher is not None:
+            self._batcher.close()
+        if self._snapshots is not None:
+            self._snapshots.close()
+        if self._namespace_manager is not None and hasattr(
+            self._namespace_manager, "close"
+        ):
+            self._namespace_manager.close()
+
+    async def serve_all(self) -> None:
+        """Run until cancelled (reference ServeAll, daemon.go:62-69)."""
+        await self.start_all()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await self.stop_all()

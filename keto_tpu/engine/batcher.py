@@ -1,0 +1,92 @@
+"""Check-request batching: concurrent RPCs -> device-wide lockstep batches.
+
+The reference runs one goroutine per request, each walking the graph alone
+(SURVEY.md §2.10). On TPU the economics invert: one batched frontier
+expansion amortizes kernel launch and HBM traffic over every in-flight
+request. The batcher is that seam: callers block on ``check()``, a dispatcher
+thread drains the queue into one ``DeviceCheckEngine.batch_check`` call —
+taking whatever has accumulated while the previous batch was on device (the
+natural batching window), plus a tiny fixed window when the queue is empty.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+from ..relationtuple.definitions import RelationTuple
+
+
+class CheckBatcher:
+    def __init__(
+        self,
+        engine,  # anything with batch_check(requests, depths=...) -> list[bool]
+        max_batch: int = 4096,
+        window_s: float = 0.0002,
+    ):
+        self.engine = engine
+        self.max_batch = max_batch
+        self.window_s = window_s
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: list[tuple[RelationTuple, int, Future]] = []
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="check-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def check(
+        self, request: RelationTuple, max_depth: int = 0, timeout: Optional[float] = None
+    ) -> bool:
+        f: Future = Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher closed")
+            self._queue.append((request, max_depth, f))
+            self._cv.notify()
+        return f.result(timeout=timeout)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+        self._thread.join(timeout=5)
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _drain(self) -> list[tuple[RelationTuple, int, Future]]:
+        batch = self._queue[: self.max_batch]
+        del self._queue[: len(batch)]
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._queue:
+                    return
+                first_only = len(self._queue) == 1
+            if first_only and self.window_s > 0:
+                # brief accumulation window; under load the device round-trip
+                # itself provides the window and this never triggers
+                time.sleep(self.window_s)
+            with self._cv:
+                batch = self._drain()
+            if not batch:
+                continue
+            requests = [b[0] for b in batch]
+            depths = [b[1] for b in batch]
+            try:
+                results = self.engine.batch_check(requests, depths=depths)
+            except Exception as e:  # propagate to every caller in the batch
+                for _, _, f in batch:
+                    if not f.done():
+                        f.set_exception(e)
+                continue
+            for (_, _, f), allowed in zip(batch, results):
+                if not f.done():
+                    f.set_result(bool(allowed))

@@ -96,6 +96,14 @@ class CheckEngine:
         return False
 
     def batch_check(
-        self, requests: list[RelationTuple], max_depth: int = 0
+        self,
+        requests: list[RelationTuple],
+        max_depth: int = 0,
+        depths: list[int] | None = None,
     ) -> list[bool]:
-        return [self.subject_is_allowed(r, max_depth) for r in requests]
+        if depths is None:
+            depths = [max_depth] * len(requests)
+        return [
+            self.subject_is_allowed(r, d)
+            for r, d in zip(requests, depths, strict=True)
+        ]

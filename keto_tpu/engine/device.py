@@ -110,6 +110,16 @@ class DeviceCheckEngine:
 
     # -- public API ----------------------------------------------------------
 
+    def warmup(self) -> None:
+        """Compile the kernel for the current snapshot shape (first XLA
+        compile is tens of seconds; serve paths call this at boot so the
+        first request doesn't pay it)."""
+        dummy = RelationTuple(
+            namespace="", object="", relation="",
+            subject=SubjectSet(namespace="", object="", relation=""),
+        )
+        self.batch_check([dummy])
+
     def subject_is_allowed(
         self, requested: RelationTuple, max_depth: int = 0
     ) -> bool:

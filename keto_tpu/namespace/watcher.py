@@ -1,0 +1,142 @@
+"""Namespace file/dir watcher with hot reload.
+
+Plays the role of the reference's watcherx-based NamespaceWatcher
+(internal/driver/config/namespace_watcher.go): namespaces come from a file or
+directory URI (``file:///etc/keto/namespaces.yml``, a bare path, or a
+directory of per-namespace files), parsed by extension (yaml/yml, json, toml
+— GetParser, namespace_watcher.go:228-239). Changes are picked up by an
+mtime-polling thread (the runtime image has no inotify binding); a parse
+error on reload keeps serving the last good set (the reference's
+rollback-to-last-good event loop, namespace_watcher.go:91-143).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+from urllib.parse import urlparse
+
+import yaml
+
+from ..utils.errors import ErrMalformedInput
+from ..utils.fileformat import load_structured_file
+from .definitions import MemoryNamespaceManager, Namespace, NamespaceManager
+
+_POLL_INTERVAL_S = 1.0
+_EXTENSIONS = (".yaml", ".yml", ".json", ".toml")
+
+
+def parse_namespace_file(path: str) -> list[Namespace]:
+    """One file may hold a single namespace object or a list of them."""
+    data = load_structured_file(path)
+    if data is None:
+        return []
+    if isinstance(data, dict):
+        # either a single namespace or {"namespaces": [...]}
+        if "namespaces" in data and isinstance(data["namespaces"], list):
+            items = data["namespaces"]
+        else:
+            items = [data]
+    elif isinstance(data, list):
+        items = data
+    else:
+        raise ErrMalformedInput(f"malformed namespace file: {path}")
+    out = []
+    for item in items:
+        if not isinstance(item, dict) or "name" not in item:
+            raise ErrMalformedInput(
+                f"namespace entries need a 'name' field: {path}"
+            )
+        out.append(
+            Namespace(
+                name=item["name"],
+                id=int(item.get("id", 0)),
+                config=item.get("config", {}) or {},
+            )
+        )
+    return out
+
+
+def _uri_to_path(uri: str) -> str:
+    if uri.startswith("file://"):
+        return urlparse(uri).path
+    return uri
+
+
+class NamespaceWatcher(NamespaceManager):
+    def __init__(self, uri: str, poll_interval_s: float = _POLL_INTERVAL_S):
+        self.path = _uri_to_path(uri)
+        self.poll_interval_s = poll_interval_s
+        self._inner = MemoryNamespaceManager()
+        self._mtimes: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._load(initial=True)
+        self._thread = threading.Thread(
+            target=self._watch_loop, name="namespace-watcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- NamespaceManager ------------------------------------------------------
+
+    def get_namespace_by_name(self, name: str) -> Namespace:
+        return self._inner.get_namespace_by_name(name)
+
+    def namespaces(self) -> list[Namespace]:
+        return self._inner.namespaces()
+
+    def should_reload(self, _page_payload=None) -> bool:
+        return True
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    # -- loading ---------------------------------------------------------------
+
+    def _files(self) -> list[str]:
+        if os.path.isdir(self.path):
+            return sorted(
+                os.path.join(self.path, f)
+                for f in os.listdir(self.path)
+                if f.endswith(_EXTENSIONS)
+            )
+        return [self.path]
+
+    def _load(self, initial: bool = False) -> None:
+        try:
+            files = self._files()
+            nss: list[Namespace] = []
+            mtimes = {}
+            for f in files:
+                mtimes[f] = os.stat(f).st_mtime
+                nss.extend(parse_namespace_file(f))
+            with self._lock:
+                self._inner.replace_all(nss)
+                self._mtimes = mtimes
+        except (OSError, ErrMalformedInput, yaml.YAMLError, json.JSONDecodeError):
+            # keep serving the last good namespace set
+            # (namespace_watcher.go:118-128); at boot an unreadable source is
+            # an empty set, like the reference before the first event
+            if initial:
+                with self._lock:
+                    self._inner.replace_all([])
+
+    def _changed(self) -> bool:
+        try:
+            files = self._files()
+        except OSError:
+            return False
+        if set(files) != set(self._mtimes):
+            return True
+        try:
+            return any(os.stat(f).st_mtime != self._mtimes[f] for f in files)
+        except OSError:
+            return True
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            if self._changed():
+                self._load()

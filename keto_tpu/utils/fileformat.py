@@ -1,0 +1,29 @@
+"""Structured-file loading shared by config and namespace sources: dispatch
+by extension — yaml/yml, json, toml (reference GetParser,
+internal/driver/config/namespace_watcher.go:228-239)."""
+
+from __future__ import annotations
+
+import json
+
+import yaml
+
+from .errors import ErrMalformedInput
+
+
+def load_structured_file(path: str):
+    with open(path) as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        return yaml.safe_load(text)
+    if path.endswith(".json"):
+        return json.loads(text)
+    if path.endswith(".toml"):
+        import tomllib
+
+        return tomllib.loads(text)
+    # YAML is a JSON superset: sensible default for extensionless files
+    try:
+        return yaml.safe_load(text)
+    except yaml.YAMLError as e:
+        raise ErrMalformedInput(f"cannot parse {path}: {e}") from e

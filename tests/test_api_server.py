@@ -1,0 +1,433 @@
+"""End-to-end API tests: boot both planes on free ports, drive them over
+real REST (httpx) and real gRPC (grpcio) — the e2e pattern of the reference
+(internal/e2e/full_suit_test.go: same scenarios through multiple client
+transports against a live server)."""
+
+import asyncio
+import json
+import threading
+
+import grpc
+import httpx
+import pytest
+
+from keto_tpu.api import (
+    acl_pb2,
+    check_service_pb2,
+    expand_service_pb2,
+    health_pb2,
+    read_service_pb2,
+    version_pb2,
+    write_service_pb2,
+)
+from keto_tpu.api.services import (
+    CheckServiceStub,
+    ExpandServiceStub,
+    HealthStub,
+    ReadServiceStub,
+    VersionServiceStub,
+    WriteServiceStub,
+)
+from keto_tpu.driver import Config, Registry
+
+
+class ServerFixture:
+    """Runs a Registry's planes in a background asyncio loop thread."""
+
+    def __init__(self, config: Config):
+        self.registry = Registry(config)
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self.loop.run_forever, daemon=True)
+        self.thread.start()
+        fut = asyncio.run_coroutine_threadsafe(
+            self.registry.start_all(), self.loop
+        )
+        self.read_port, self.write_port = fut.result(timeout=30)
+
+    def stop(self):
+        asyncio.run_coroutine_threadsafe(
+            self.registry.stop_all(), self.loop
+        ).result(timeout=10)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = Config(
+        values={
+            "namespaces": [{"id": 1, "name": "videos"}, {"id": 2, "name": "n"}],
+            "serve": {
+                "read": {"port": 0, "host": "127.0.0.1"},
+                "write": {"port": 0, "host": "127.0.0.1"},
+            },
+        }
+    )
+    s = ServerFixture(cfg)
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def clean(server):
+    server.registry.store().delete_all_relation_tuples(
+        __import__("keto_tpu.relationtuple", fromlist=["RelationQuery"]).RelationQuery()
+    )
+    return server
+
+
+def rest(server, plane="read"):
+    port = server.read_port if plane == "read" else server.write_port
+    # generous timeout: shape growth can trigger an XLA recompile mid-test
+    return httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=60)
+
+
+class TestRest:
+    def test_health_and_version(self, clean):
+        with rest(clean) as c:
+            assert c.get("/health/alive").json() == {"status": "ok"}
+            assert c.get("/health/ready").status_code == 200
+            assert "version" in c.get("/version").json()
+        with rest(clean, "write") as c:
+            assert c.get("/health/alive").status_code == 200
+
+    def test_create_check_expand_flow(self, clean):
+        with rest(clean, "write") as w:
+            r = w.put(
+                "/relation-tuples",
+                json={
+                    "namespace": "videos",
+                    "object": "/cats",
+                    "relation": "owner",
+                    "subject_id": "cat lady",
+                },
+            )
+            assert r.status_code == 201, r.text
+            assert r.headers["Location"].startswith("/relation-tuples?")
+            r = w.put(
+                "/relation-tuples",
+                json={
+                    "namespace": "videos",
+                    "object": "/cats/1.mp4",
+                    "relation": "view",
+                    "subject_set": {
+                        "namespace": "videos",
+                        "object": "/cats",
+                        "relation": "owner",
+                    },
+                },
+            )
+            assert r.status_code == 201
+        with rest(clean) as c:
+            r = c.get(
+                "/check",
+                params={
+                    "namespace": "videos",
+                    "object": "/cats/1.mp4",
+                    "relation": "view",
+                    "subject_id": "cat lady",
+                },
+            )
+            assert r.status_code == 200
+            assert r.json() == {"allowed": True}
+            r = c.get(
+                "/check",
+                params={
+                    "namespace": "videos",
+                    "object": "/cats/1.mp4",
+                    "relation": "view",
+                    "subject_id": "dog guy",
+                },
+            )
+            assert r.status_code == 403
+            assert r.json() == {"allowed": False}
+            # POST form
+            r = c.post(
+                "/check",
+                json={
+                    "namespace": "videos",
+                    "object": "/cats",
+                    "relation": "owner",
+                    "subject_id": "cat lady",
+                },
+            )
+            assert r.status_code == 200
+            # expand
+            r = c.get(
+                "/expand",
+                params={
+                    "namespace": "videos",
+                    "object": "/cats/1.mp4",
+                    "relation": "view",
+                },
+            )
+            assert r.status_code == 200
+            tree = r.json()
+            assert tree["type"] == "union"
+            assert tree["children"][0]["subject_set"]["relation"] == "owner"
+
+    def test_list_and_pagination(self, clean):
+        with rest(clean, "write") as w:
+            for i in range(5):
+                assert (
+                    w.put(
+                        "/relation-tuples",
+                        json={
+                            "namespace": "n",
+                            "object": "o",
+                            "relation": "r",
+                            "subject_id": f"u{i}",
+                        },
+                    ).status_code
+                    == 201
+                )
+        with rest(clean) as c:
+            r = c.get(
+                "/relation-tuples",
+                params={"namespace": "n", "page_size": 2},
+            )
+            body = r.json()
+            assert len(body["relation_tuples"]) == 2
+            assert body["next_page_token"]
+            r2 = c.get(
+                "/relation-tuples",
+                params={
+                    "namespace": "n",
+                    "page_size": 2,
+                    "page_token": body["next_page_token"],
+                },
+            )
+            assert len(r2.json()["relation_tuples"]) == 2
+            # bad token -> 400
+            r3 = c.get(
+                "/relation-tuples",
+                params={"namespace": "n", "page_token": "$$garbage$$"},
+            )
+            assert r3.status_code == 400
+            assert "error" in r3.json()
+
+    def test_patch_and_delete(self, clean):
+        with rest(clean, "write") as w:
+            r = w.patch(
+                "/relation-tuples",
+                json=[
+                    {
+                        "action": "insert",
+                        "relation_tuple": {
+                            "namespace": "n",
+                            "object": "o",
+                            "relation": "r",
+                            "subject_id": "alice",
+                        },
+                    },
+                    {
+                        "action": "insert",
+                        "relation_tuple": {
+                            "namespace": "n",
+                            "object": "o",
+                            "relation": "r",
+                            "subject_id": "bob",
+                        },
+                    },
+                ],
+            )
+            assert r.status_code == 204
+            # unknown action -> 400, nothing applied
+            r = w.patch(
+                "/relation-tuples",
+                json=[
+                    {
+                        "action": "upsert",
+                        "relation_tuple": {
+                            "namespace": "n",
+                            "object": "o",
+                            "relation": "r",
+                            "subject_id": "eve",
+                        },
+                    }
+                ],
+            )
+            assert r.status_code == 400
+            r = w.delete(
+                "/relation-tuples", params={"namespace": "n", "subject_id": "bob"}
+            )
+            assert r.status_code == 204
+        with rest(clean) as c:
+            body = c.get("/relation-tuples", params={"namespace": "n"}).json()
+            subjects = {t["subject_id"] for t in body["relation_tuples"]}
+            assert subjects == {"alice"}
+
+    def test_unknown_namespace_404(self, clean):
+        with rest(clean, "write") as w:
+            r = w.put(
+                "/relation-tuples",
+                json={
+                    "namespace": "nope",
+                    "object": "o",
+                    "relation": "r",
+                    "subject_id": "alice",
+                },
+            )
+            assert r.status_code == 404
+            assert r.json()["error"]["code"] == 404
+
+    def test_malformed_subject_params(self, clean):
+        with rest(clean) as c:
+            r = c.get(
+                "/check",
+                params={
+                    "namespace": "n",
+                    "object": "o",
+                    "relation": "r",
+                    "subject_id": "x",
+                    "subject_set.namespace": "n",
+                    "subject_set.object": "o",
+                    "subject_set.relation": "r",
+                },
+            )
+            assert r.status_code == 400
+
+
+def grpc_channel(server, plane="read"):
+    port = server.read_port if plane == "read" else server.write_port
+    return grpc.insecure_channel(f"127.0.0.1:{port}")
+
+
+class TestGrpc:
+    def test_write_then_check_expand_list(self, clean):
+        with grpc_channel(clean, "write") as wch:
+            write = WriteServiceStub(wch)
+            deltas = [
+                write_service_pb2.RelationTupleDelta(
+                    action=write_service_pb2.RelationTupleDelta.INSERT,
+                    relation_tuple=acl_pb2.RelationTuple(
+                        namespace="n",
+                        object="o",
+                        relation="r",
+                        subject=acl_pb2.Subject(id="alice"),
+                    ),
+                ),
+                write_service_pb2.RelationTupleDelta(
+                    action=write_service_pb2.RelationTupleDelta.INSERT,
+                    relation_tuple=acl_pb2.RelationTuple(
+                        namespace="n",
+                        object="o2",
+                        relation="r",
+                        subject=acl_pb2.Subject(
+                            set=acl_pb2.SubjectSet(
+                                namespace="n", object="o", relation="r"
+                            )
+                        ),
+                    ),
+                ),
+            ]
+            resp = write.TransactRelationTuples(
+                write_service_pb2.TransactRelationTuplesRequest(
+                    relation_tuple_deltas=deltas
+                )
+            )
+            assert len(resp.snaptokens) == 2
+            assert resp.snaptokens[0] != ""
+
+        with grpc_channel(clean) as rch:
+            check = CheckServiceStub(rch)
+            r = check.Check(
+                check_service_pb2.CheckRequest(
+                    namespace="n",
+                    object="o2",
+                    relation="r",
+                    subject=acl_pb2.Subject(id="alice"),
+                )
+            )
+            assert r.allowed is True
+            assert r.snaptoken != ""
+            r = check.Check(
+                check_service_pb2.CheckRequest(
+                    namespace="n",
+                    object="o2",
+                    relation="r",
+                    subject=acl_pb2.Subject(id="mallory"),
+                )
+            )
+            assert r.allowed is False
+
+            expand = ExpandServiceStub(rch)
+            t = expand.Expand(
+                expand_service_pb2.ExpandRequest(
+                    subject=acl_pb2.Subject(
+                        set=acl_pb2.SubjectSet(
+                            namespace="n", object="o2", relation="r"
+                        )
+                    )
+                )
+            )
+            assert t.tree.node_type == expand_service_pb2.NODE_TYPE_UNION
+
+            read = ReadServiceStub(rch)
+            lst = read.ListRelationTuples(
+                read_service_pb2.ListRelationTuplesRequest(
+                    query=read_service_pb2.ListRelationTuplesRequest.Query(
+                        namespace="n"
+                    )
+                )
+            )
+            assert len(lst.relation_tuples) == 2
+
+    def test_check_without_subject_invalid(self, clean):
+        with grpc_channel(clean) as rch:
+            check = CheckServiceStub(rch)
+            with pytest.raises(grpc.RpcError) as e:
+                check.Check(
+                    check_service_pb2.CheckRequest(
+                        namespace="n", object="o", relation="r"
+                    )
+                )
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_version_and_health(self, clean):
+        with grpc_channel(clean) as rch:
+            v = VersionServiceStub(rch).GetVersion(
+                version_pb2.GetVersionRequest()
+            )
+            assert v.version
+            h = HealthStub(rch).Check(health_pb2.HealthCheckRequest())
+            assert h.status == health_pb2.HealthCheckResponse.SERVING
+        with grpc_channel(clean, "write") as wch:
+            h = HealthStub(wch).Check(health_pb2.HealthCheckRequest())
+            assert h.status == health_pb2.HealthCheckResponse.SERVING
+
+    def test_delete_by_query(self, clean):
+        with grpc_channel(clean, "write") as wch:
+            write = WriteServiceStub(wch)
+            write.TransactRelationTuples(
+                write_service_pb2.TransactRelationTuplesRequest(
+                    relation_tuple_deltas=[
+                        write_service_pb2.RelationTupleDelta(
+                            action=write_service_pb2.RelationTupleDelta.INSERT,
+                            relation_tuple=acl_pb2.RelationTuple(
+                                namespace="n",
+                                object="o",
+                                relation="r",
+                                subject=acl_pb2.Subject(id=f"u{i}"),
+                            ),
+                        )
+                        for i in range(3)
+                    ]
+                )
+            )
+            write.DeleteRelationTuples(
+                write_service_pb2.DeleteRelationTuplesRequest(
+                    query=write_service_pb2.DeleteRelationTuplesRequest.Query(
+                        namespace="n", object="o"
+                    )
+                )
+            )
+        with grpc_channel(clean) as rch:
+            lst = ReadServiceStub(rch).ListRelationTuples(
+                read_service_pb2.ListRelationTuplesRequest(
+                    query=read_service_pb2.ListRelationTuplesRequest.Query(
+                        namespace="n"
+                    )
+                )
+            )
+            assert len(lst.relation_tuples) == 0
