@@ -56,14 +56,19 @@ class _MuxedPort:
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
 
-    async def stop(self) -> None:
+    async def stop(self, grace: float = 2.0) -> None:
         if self._server is not None:
             self._server.close()
-            # cancel live proxied connections: wait_closed() would block on
-            # idle keep-alive clients (3.12 waits for connection handlers)
-            for task in list(self._conns):
-                task.cancel()
-            await asyncio.gather(*self._conns, return_exceptions=True)
+            # let in-flight requests drain for the grace window, then sever
+            # whatever remains (idle keep-alives included — 3.12's
+            # wait_closed() would otherwise block on them forever)
+            if self._conns:
+                _, pending = await asyncio.wait(
+                    list(self._conns), timeout=grace
+                )
+                for task in pending:
+                    task.cancel()
+                await asyncio.gather(*pending, return_exceptions=True)
 
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -175,7 +180,7 @@ class PlaneServer:
 
     async def stop(self, grace: float = 2.0) -> None:
         if self._mux is not None:
-            await self._mux.stop()
+            await self._mux.stop(grace)
         self.grpc_server.stop(grace)
         if self._runner is not None:
             await self._runner.cleanup()

@@ -84,11 +84,13 @@ def make_cors_middleware(cfg: Optional[dict]):
     async def cors_middleware(request: web.Request, handler):
         origin = request.headers.get("Origin")
         if not enabled or not origin:
-            if request.method == "OPTIONS":
-                return web.Response(status=204)
             return await handler(request)
         ok = "*" in allowed_origins or origin in allowed_origins
-        if request.method == "OPTIONS":
+        is_preflight = (
+            request.method == "OPTIONS"
+            and "Access-Control-Request-Method" in request.headers
+        )
+        if is_preflight:
             resp = web.Response(status=204)
         else:
             resp = await handler(request)
@@ -160,11 +162,16 @@ async def _json_body(request: web.Request):
 
 
 class ReadAPI:
-    def __init__(self, manager, checker, expand_engine, snaptoken_fn):
+    def __init__(
+        self, manager, checker, expand_engine, snaptoken_fn, executor=None
+    ):
         self.manager = manager
         self.checker = checker
         self.expand_engine = expand_engine
         self.snaptoken_fn = snaptoken_fn
+        # sized by the registry so in-flight checks can fill a device batch
+        # (the loop's default executor caps at ~32 threads)
+        self.executor = executor
 
     def register(self, app: web.Application) -> None:
         app.router.add_get(ROUTE_TUPLES, self.get_relations)
@@ -212,7 +219,7 @@ class ReadAPI:
         # the check blocks on device compute (or the batcher window) — run it
         # off the event loop so concurrent requests accumulate into batches
         allowed = await asyncio.get_running_loop().run_in_executor(
-            None, self.checker.check, tup, max_depth
+            self.executor, self.checker.check, tup, max_depth
         )
         # 200 when allowed, 403 when denied — both carry the body
         # (reference check/handler.go:120-139)
@@ -230,7 +237,7 @@ class ReadAPI:
         )
         depth = max_depth_from_query(p)
         tree = await asyncio.get_running_loop().run_in_executor(
-            None, self.expand_engine.build_tree, subject, depth
+            self.executor, self.expand_engine.build_tree, subject, depth
         )
         # nil tree serializes as null with 200, like the reference's
         # herodot Write of a nil pointer (expand/handler.go:90)
@@ -327,13 +334,13 @@ def register_common(app: web.Application, version: str, healthy_fn=None) -> None
 
 def build_read_app(
     manager, checker, expand_engine, snaptoken_fn, version: str,
-    cors: Optional[dict] = None, healthy_fn=None,
+    cors: Optional[dict] = None, healthy_fn=None, executor=None,
 ) -> web.Application:
     # CORS outermost so error responses also carry the headers
     app = web.Application(
         middlewares=[make_cors_middleware(cors), error_middleware]
     )
-    ReadAPI(manager, checker, expand_engine, snaptoken_fn).register(app)
+    ReadAPI(manager, checker, expand_engine, snaptoken_fn, executor).register(app)
     register_common(app, version, healthy_fn)
     return app
 

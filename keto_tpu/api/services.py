@@ -187,12 +187,24 @@ class HealthServicer:
     def __init__(self):
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._status = health_pb2.HealthCheckResponse.SERVING
+        # NOT_SERVING until the registry finishes bring-up (warmup included)
+        self._status = health_pb2.HealthCheckResponse.NOT_SERVING
 
     def set_status(self, status) -> None:
         with self._cv:
             self._status = status
             self._cv.notify_all()
+
+    def set_serving(self, serving: bool) -> None:
+        self.set_status(
+            health_pb2.HealthCheckResponse.SERVING
+            if serving
+            else health_pb2.HealthCheckResponse.NOT_SERVING
+        )
+
+    def is_serving(self) -> bool:
+        with self._lock:
+            return self._status == health_pb2.HealthCheckResponse.SERVING
 
     def Check(self, request, context):
         with self._lock:

@@ -1,0 +1,6 @@
+"""CLI (reference cmd/): keto serve / check / expand / relation-tuple /
+migrate / namespace / status / version."""
+
+from .main import cli
+
+__all__ = ["cli"]

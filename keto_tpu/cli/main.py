@@ -1,0 +1,478 @@
+"""The keto CLI (reference cmd/root.go:45-63 command tree).
+
+Client commands speak gRPC to a running server; remotes resolve flag -> env
+(KETO_READ_REMOTE / KETO_WRITE_REMOTE) -> default 127.0.0.1:4466/4467
+(reference cmd/client/grpc_client.go:17-70). Server commands (serve,
+migrate) build a Registry from the config file.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import sys
+import time
+
+import click
+import grpc
+
+DEFAULT_READ_REMOTE = "127.0.0.1:4466"
+DEFAULT_WRITE_REMOTE = "127.0.0.1:4467"
+_CONN_TIMEOUT_S = 3  # reference grpc_client.go:49-70 dials with 3s timeout
+
+
+def _read_remote(ctx) -> str:
+    return (
+        ctx.obj.get("read_remote")
+        or os.environ.get("KETO_READ_REMOTE")
+        or DEFAULT_READ_REMOTE
+    )
+
+
+def _write_remote(ctx) -> str:
+    return (
+        ctx.obj.get("write_remote")
+        or os.environ.get("KETO_WRITE_REMOTE")
+        or DEFAULT_WRITE_REMOTE
+    )
+
+
+def _channel(remote: str) -> grpc.Channel:
+    ch = grpc.insecure_channel(remote)
+    try:
+        grpc.channel_ready_future(ch).result(timeout=_CONN_TIMEOUT_S)
+    except grpc.FutureTimeoutError:
+        raise click.ClickException(
+            f"cannot connect to {remote} within {_CONN_TIMEOUT_S}s"
+        ) from None
+    return ch
+
+
+def _fail_rpc(e: grpc.RpcError):
+    raise click.ClickException(f"{e.code().name}: {e.details()}")
+
+
+@click.group()
+@click.option(
+    "--read-remote", envvar="KETO_READ_REMOTE", default=None,
+    help="gRPC remote of the read API (host:port)",
+)
+@click.option(
+    "--write-remote", envvar="KETO_WRITE_REMOTE", default=None,
+    help="gRPC remote of the write API (host:port)",
+)
+@click.pass_context
+def cli(ctx, read_remote, write_remote):
+    """keto_tpu — Zanzibar-style permission server, TPU-native."""
+    ctx.ensure_object(dict)
+    ctx.obj["read_remote"] = read_remote
+    ctx.obj["write_remote"] = write_remote
+
+
+# -- serve ---------------------------------------------------------------------
+
+
+@cli.command()
+@click.option("--config", "-c", "config_file", default=None, type=click.Path())
+@click.pass_context
+def serve(ctx, config_file):
+    """Start the read (:4466) and write (:4467) servers
+    (reference cmd/server/serve.go)."""
+    from ..driver import Config, Registry
+
+    registry = Registry(Config(config_file=config_file))
+
+    async def _run():
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        read_port, write_port = await registry.start_all()
+        click.echo(f"read API serving on :{read_port} (REST + gRPC)")
+        click.echo(f"write API serving on :{write_port} (REST + gRPC)")
+        await stop.wait()
+        click.echo("shutting down gracefully...")
+        await registry.stop_all()
+
+    asyncio.run(_run())
+
+
+# -- check / expand ------------------------------------------------------------
+
+
+@cli.command()
+@click.argument("subject")
+@click.argument("relation")
+@click.argument("namespace")
+@click.argument("object")
+@click.option("--max-depth", default=0, type=int)
+@click.option("--format", "fmt", default="human", type=click.Choice(["human", "json"]))
+@click.pass_context
+def check(ctx, subject, relation, namespace, object, max_depth, fmt):
+    """Check whether SUBJECT has RELATION on NAMESPACE:OBJECT
+    (reference cmd/check/root.go:27-72)."""
+    from ..api import acl_pb2, check_service_pb2
+    from ..api.convert import subject_to_proto
+    from ..api.services import CheckServiceStub
+    from ..relationtuple.definitions import subject_from_string
+
+    with _channel(_read_remote(ctx)) as ch:
+        try:
+            resp = CheckServiceStub(ch).Check(
+                check_service_pb2.CheckRequest(
+                    namespace=namespace,
+                    object=object,
+                    relation=relation,
+                    subject=subject_to_proto(subject_from_string(subject)),
+                    max_depth=max_depth,
+                )
+            )
+        except grpc.RpcError as e:
+            _fail_rpc(e)
+    if fmt == "json":
+        click.echo(json.dumps({"allowed": resp.allowed}))
+    else:
+        click.echo("Allowed" if resp.allowed else "Denied")
+    sys.exit(0 if resp.allowed else 1)
+
+
+@cli.command()
+@click.argument("relation")
+@click.argument("namespace")
+@click.argument("object")
+@click.option("--max-depth", default=0, type=int)
+@click.option("--format", "fmt", default="human", type=click.Choice(["human", "json"]))
+@click.pass_context
+def expand(ctx, relation, namespace, object, max_depth, fmt):
+    """Expand the subject set NAMESPACE:OBJECT#RELATION into its tree
+    (reference cmd/expand/root.go:18-88)."""
+    from ..api import acl_pb2, expand_service_pb2
+    from ..api.convert import tree_from_proto
+    from ..api.services import ExpandServiceStub
+
+    with _channel(_read_remote(ctx)) as ch:
+        try:
+            resp = ExpandServiceStub(ch).Expand(
+                expand_service_pb2.ExpandRequest(
+                    subject=acl_pb2.Subject(
+                        set=acl_pb2.SubjectSet(
+                            namespace=namespace, object=object, relation=relation
+                        )
+                    ),
+                    max_depth=max_depth,
+                )
+            )
+        except grpc.RpcError as e:
+            _fail_rpc(e)
+    tree = tree_from_proto(resp.tree) if resp.HasField("tree") else None
+    if fmt == "json":
+        click.echo(json.dumps(None if tree is None else tree.to_dict(), indent=2))
+    elif tree is None:
+        click.echo("null")
+    else:
+        click.echo(str(tree))
+
+
+# -- relation-tuple ------------------------------------------------------------
+
+
+@cli.group("relation-tuple")
+def relation_tuple():
+    """Create, delete, query, and parse relation tuples
+    (reference cmd/relationtuple)."""
+
+
+def _read_tuple_sources(sources) -> list:
+    """JSON tuples from files, directories, or '-' for stdin
+    (reference cmd/relationtuple/create.go:35-100)."""
+    from ..relationtuple.definitions import RelationTuple
+
+    out = []
+
+    def from_text(text: str):
+        data = json.loads(text)
+        items = data if isinstance(data, list) else [data]
+        for item in items:
+            item.pop("$schema", None)
+            out.append(RelationTuple.from_dict(item))
+
+    for src in sources or ("-",):
+        if src == "-":
+            from_text(sys.stdin.read())
+        elif os.path.isdir(src):
+            for name in sorted(os.listdir(src)):
+                if name.endswith(".json"):
+                    with open(os.path.join(src, name)) as f:
+                        from_text(f.read())
+        else:
+            with open(src) as f:
+                from_text(f.read())
+    return out
+
+
+def _transact(ctx, tuples, action):
+    from ..api import write_service_pb2
+    from ..api.convert import tuple_to_proto
+    from ..api.services import WriteServiceStub
+
+    deltas = [
+        write_service_pb2.RelationTupleDelta(
+            action=action, relation_tuple=tuple_to_proto(t)
+        )
+        for t in tuples
+    ]
+    with _channel(_write_remote(ctx)) as ch:
+        try:
+            WriteServiceStub(ch).TransactRelationTuples(
+                write_service_pb2.TransactRelationTuplesRequest(
+                    relation_tuple_deltas=deltas
+                )
+            )
+        except grpc.RpcError as e:
+            _fail_rpc(e)
+
+
+@relation_tuple.command()
+@click.argument("sources", nargs=-1, type=click.Path())
+@click.pass_context
+def create(ctx, sources):
+    """Create tuples from JSON files, dirs, or stdin."""
+    tuples = _read_tuple_sources(sources)
+    _transact(ctx, tuples, action=1)  # INSERT
+    click.echo(f"created {len(tuples)} relation tuples")
+
+
+@relation_tuple.command()
+@click.argument("sources", nargs=-1, type=click.Path())
+@click.pass_context
+def delete(ctx, sources):
+    """Delete the exact tuples given as JSON files, dirs, or stdin."""
+    tuples = _read_tuple_sources(sources)
+    _transact(ctx, tuples, action=2)  # DELETE
+    click.echo(f"deleted {len(tuples)} relation tuples")
+
+
+@relation_tuple.command("delete-all")
+@click.option("--namespace", default=None)
+@click.option("--object", default=None)
+@click.option("--relation", default=None)
+@click.option("--subject-id", default=None)
+@click.option("--force", is_flag=True, help="skip confirmation")
+@click.pass_context
+def delete_all(ctx, namespace, object, relation, subject_id, force):
+    """Delete all tuples matching the query flags
+    (reference cmd/relationtuple/delete.go)."""
+    from ..api import write_service_pb2
+    from ..api.services import WriteServiceStub
+    from ..api import acl_pb2
+
+    if not force:
+        click.confirm(
+            "Are you sure you want to delete all matching relation tuples?",
+            abort=True,
+        )
+    q = write_service_pb2.DeleteRelationTuplesRequest.Query(
+        namespace=namespace or "",
+        object=object or "",
+        relation=relation or "",
+    )
+    if subject_id:
+        q.subject.CopyFrom(acl_pb2.Subject(id=subject_id))
+    with _channel(_write_remote(ctx)) as ch:
+        try:
+            WriteServiceStub(ch).DeleteRelationTuples(
+                write_service_pb2.DeleteRelationTuplesRequest(query=q)
+            )
+        except grpc.RpcError as e:
+            _fail_rpc(e)
+    click.echo("deleted all matching relation tuples")
+
+
+@relation_tuple.command()
+@click.option("--namespace", default=None)
+@click.option("--object", default=None)
+@click.option("--relation", default=None)
+@click.option("--subject-id", default=None)
+@click.option("--page-size", default=100, type=int)
+@click.option("--page-token", default="", type=str)
+@click.option("--format", "fmt", default="human", type=click.Choice(["human", "json"]))
+@click.pass_context
+def get(ctx, namespace, object, relation, subject_id, page_size, page_token, fmt):
+    """Query tuples as a table or JSON (reference cmd/relationtuple/get.go)."""
+    from ..api import acl_pb2, read_service_pb2
+    from ..api.convert import tuple_from_proto
+    from ..api.services import ReadServiceStub
+    from ..relationtuple.definitions import relation_collection_table
+
+    q = read_service_pb2.ListRelationTuplesRequest.Query(
+        namespace=namespace or "",
+        object=object or "",
+        relation=relation or "",
+    )
+    if subject_id:
+        q.subject.CopyFrom(acl_pb2.Subject(id=subject_id))
+    with _channel(_read_remote(ctx)) as ch:
+        try:
+            resp = ReadServiceStub(ch).ListRelationTuples(
+                read_service_pb2.ListRelationTuplesRequest(
+                    query=q, page_size=page_size, page_token=page_token
+                )
+            )
+        except grpc.RpcError as e:
+            _fail_rpc(e)
+    tuples = [tuple_from_proto(p) for p in resp.relation_tuples]
+    if fmt == "json":
+        click.echo(
+            json.dumps(
+                {
+                    "relation_tuples": [t.to_dict() for t in tuples],
+                    "next_page_token": resp.next_page_token,
+                },
+                indent=2,
+            )
+        )
+    else:
+        click.echo(relation_collection_table(tuples))
+        if resp.next_page_token:
+            click.echo(f"\nnext page token: {resp.next_page_token}")
+
+
+@relation_tuple.command()
+@click.argument("sources", nargs=-1, type=click.Path())
+def parse(sources):
+    """Parse the human-readable ns:obj#rel@subject grammar into JSON;
+    //-comments and blank lines are skipped (reference cmd/relationtuple/
+    parse.go:47-88)."""
+    from ..relationtuple.definitions import parse_tuples_text
+
+    for src in sources or ("-",):
+        text = sys.stdin.read() if src == "-" else open(src).read()
+        for t in parse_tuples_text(text):
+            click.echo(json.dumps(t.to_dict()))
+
+
+# -- migrate -------------------------------------------------------------------
+
+
+def _store_for_migrate(config_file):
+    from ..driver import Config, Registry
+
+    registry = Registry(Config(config_file=config_file))
+    store = registry.store()
+    if not hasattr(store, "migrator"):
+        raise click.ClickException(
+            "DSN has no migrations (the in-memory store migrates implicitly)"
+        )
+    return store
+
+
+@cli.group()
+def migrate():
+    """Apply or inspect SQL schema migrations (reference cmd/migrate)."""
+
+
+@migrate.command("status")
+@click.option("--config", "-c", "config_file", default=None, type=click.Path())
+def migrate_status(config_file):
+    store = _store_for_migrate(config_file)
+    for s in store.migrator.status():
+        state = "applied" if s.applied else "pending"
+        click.echo(f"{s.version}\t{s.name}\t{state}")
+
+
+@migrate.command("up")
+@click.option("--config", "-c", "config_file", default=None, type=click.Path())
+@click.option("--yes", is_flag=True, help="skip confirmation")
+def migrate_up(config_file, yes):
+    store = _store_for_migrate(config_file)
+    pending = [s for s in store.migrator.status() if not s.applied]
+    if not pending:
+        click.echo("already up to date")
+        return
+    for s in pending:
+        click.echo(f"pending: {s.version} {s.name}")
+    if not yes:
+        click.confirm("Apply these migrations?", abort=True)
+    ran = store.migrator.up()
+    click.echo(f"applied {len(ran)} migrations")
+
+
+@migrate.command("down")
+@click.argument("steps", type=int)
+@click.option("--config", "-c", "config_file", default=None, type=click.Path())
+@click.option("--yes", is_flag=True, help="skip confirmation")
+def migrate_down(config_file, steps, yes):
+    store = _store_for_migrate(config_file)
+    if not yes:
+        click.confirm(f"Roll back {steps} migrations?", abort=True)
+    ran = store.migrator.down(steps=steps)
+    click.echo(f"rolled back {len(ran)} migrations")
+
+
+# -- namespace -----------------------------------------------------------------
+
+
+@cli.group()
+def namespace():
+    """Namespace utilities (reference cmd/namespace)."""
+
+
+@namespace.command()
+@click.argument("files", nargs=-1, required=True, type=click.Path(exists=True))
+def validate(files):
+    """Validate namespace files (reference cmd/namespace/validate.go:21-58)."""
+    from ..namespace.watcher import parse_namespace_file
+    from ..utils.errors import ErrMalformedInput
+
+    failed = False
+    for f in files:
+        try:
+            nss = parse_namespace_file(f)
+            click.echo(f"{f}: OK ({len(nss)} namespaces)")
+        except (ErrMalformedInput, Exception) as e:  # noqa: BLE001
+            failed = True
+            click.echo(f"{f}: INVALID — {e}", err=True)
+    if failed:
+        sys.exit(1)
+
+
+# -- status / version ----------------------------------------------------------
+
+
+@cli.command()
+@click.option("--block", is_flag=True, help="wait until the server is SERVING")
+@click.option("--timeout", "timeout_s", default=0, type=float,
+              help="give up after this many seconds (0 = forever)")
+@click.pass_context
+def status(ctx, block, timeout_s):
+    """Health of the read API; --block watches until SERVING
+    (reference cmd/status/root.go:28-110)."""
+    from ..api import health_pb2
+    from ..api.services import HealthStub
+
+    deadline = time.monotonic() + timeout_s if timeout_s else None
+    while True:
+        try:
+            with _channel(_read_remote(ctx)) as ch:
+                resp = HealthStub(ch).Check(health_pb2.HealthCheckRequest())
+            name = health_pb2.HealthCheckResponse.ServingStatus.Name(resp.status)
+            click.echo(name)
+            if resp.status == health_pb2.HealthCheckResponse.SERVING or not block:
+                return
+        except click.ClickException:
+            if not block:
+                raise
+            click.echo("NOT_REACHABLE")
+        if deadline is not None and time.monotonic() > deadline:
+            raise click.ClickException("timed out waiting for SERVING")
+        time.sleep(1)
+
+
+@cli.command()
+def version():
+    """Print the build version (reference cmd/root.go:60)."""
+    from .. import __version__
+
+    click.echo(__version__)

@@ -100,7 +100,9 @@ CONFIG_SCHEMA = {
         "engine": {
             "type": "object",
             "properties": {
-                "mode": {"enum": ["device", "host", "auto"]},
+                "mode": {
+                    "enum": ["device", "host", "auto", "dense", "scatter"]
+                },
                 "dense_threshold": {"type": "integer", "minimum": 2},
                 "max_batch": {"type": "integer", "minimum": 1},
                 "batch_window_us": {"type": "number", "minimum": 0},

@@ -44,6 +44,7 @@ class Registry:
         self.version = __version__
         self._read_plane: Optional[PlaneServer] = None
         self._write_plane: Optional[PlaneServer] = None
+        self._check_executor = None
 
     # -- providers (lazy, like RegistryDefault's memoized getters) ------------
 
@@ -86,13 +87,16 @@ class Registry:
     def check_engine(self):
         if self._check_engine is None:
             max_depth = self.config.read_api_max_depth()
-            if self.config.engine_mode() == "host":
+            mode = self.config.engine_mode()
+            if mode == "host":
                 self._check_engine = CheckEngine(self.store(), max_depth=max_depth)
             else:
+                # 'device'/'auto' -> size-based propagation choice;
+                # 'dense'/'scatter' force that propagation path
                 self._check_engine = DeviceCheckEngine(
                     self.snapshots(),
                     max_depth=max_depth,
-                    mode="auto",
+                    mode=mode if mode in ("dense", "scatter") else "auto",
                     dense_threshold=int(
                         self.config.get("engine.dense_threshold")
                     ),
@@ -134,6 +138,22 @@ class Registry:
 
     # -- serving ---------------------------------------------------------------
 
+    def _grpc_workers(self) -> int:
+        # every in-flight check blocks a worker; size the pools so a device
+        # batch can actually fill (capped: threads blocked on futures are
+        # cheap but not free)
+        return min(int(self.config.get("engine.max_batch")), 512)
+
+    def check_executor(self):
+        if self._check_executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._check_executor = ThreadPoolExecutor(
+                max_workers=self._grpc_workers(),
+                thread_name_prefix="rest-check",
+            )
+        return self._check_executor
+
     def read_plane(self) -> PlaneServer:
         if self._read_plane is None:
             grpc_server = build_read_grpc_server(
@@ -143,6 +163,7 @@ class Registry:
                 self.snaptoken,
                 self.version,
                 self.health,
+                max_workers=self._grpc_workers(),
             )
             app = build_read_app(
                 self.store(),
@@ -151,6 +172,8 @@ class Registry:
                 self.snaptoken,
                 self.version,
                 cors=self.config.cors("read"),
+                healthy_fn=self.health.is_serving,
+                executor=self.check_executor(),
             )
             self._read_plane = PlaneServer(
                 grpc_server,
@@ -170,6 +193,7 @@ class Registry:
                 self.snaptoken,
                 self.version,
                 cors=self.config.cors("write"),
+                healthy_fn=self.health.is_serving,
             )
             self._write_plane = PlaneServer(
                 grpc_server,
@@ -189,9 +213,12 @@ class Registry:
             )
         read_port = await self.read_plane().start()
         write_port = await self.write_plane().start()
+        self.health.set_serving(True)  # readiness flips only after bring-up
         return read_port, write_port
 
     async def stop_all(self) -> None:
+        # flip readiness first so load balancers stop routing here
+        self.health.set_serving(False)
         if self._read_plane is not None:
             await self._read_plane.stop()
         if self._write_plane is not None:
@@ -204,6 +231,8 @@ class Registry:
             self._namespace_manager, "close"
         ):
             self._namespace_manager.close()
+        if self._check_executor is not None:
+            self._check_executor.shutdown(wait=False, cancel_futures=True)
 
     async def serve_all(self) -> None:
         """Run until cancelled (reference ServeAll, daemon.go:62-69)."""

@@ -12,13 +12,9 @@ rollback-to-last-good event loop, namespace_watcher.go:91-143).
 
 from __future__ import annotations
 
-import json
 import os
 import threading
-from typing import Optional
 from urllib.parse import urlparse
-
-import yaml
 
 from ..utils.errors import ErrMalformedInput
 from ..utils.fileformat import load_structured_file
@@ -116,7 +112,7 @@ class NamespaceWatcher(NamespaceManager):
             with self._lock:
                 self._inner.replace_all(nss)
                 self._mtimes = mtimes
-        except (OSError, ErrMalformedInput, yaml.YAMLError, json.JSONDecodeError):
+        except (OSError, ErrMalformedInput):
             # keep serving the last good namespace set
             # (namespace_watcher.go:118-128); at boot an unreadable source is
             # an empty set, like the reference before the first event

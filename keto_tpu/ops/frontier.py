@@ -37,8 +37,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-# Unreachable sentinel for distance labels (depth budgets are tiny ints).
-UNREACHED = jnp.int32(0x7FFFFFFF)
+# Unreachable sentinel for distance labels (plain int: importing this module
+# must not initialize a JAX backend).
+UNREACHED = 0x7FFFFFFF
 
 
 def pick_edge_chunk(

@@ -1,0 +1,17 @@
+"""Durable persistence backends.
+
+The reference ships a pop/soda SQL persister over sqlite/MySQL/Postgres/
+CockroachDB with embedded migrations (reference internal/persistence/sql).
+This build ships the sqlite backend on the stdlib driver (the runtime image
+carries no Postgres/MySQL drivers; those DSNs are rejected with a clear
+error at config time) plus the same migration machinery: versioned SQL
+files, up/down/status, applied-version bookkeeping.
+
+The device snapshot layer is persistence-agnostic: any store exposing the
+Manager contract plus the version/delta feed can sit under it.
+"""
+
+from .migrator import Migrator, MigrationStatus
+from .sqlite import SQLiteTupleStore
+
+__all__ = ["Migrator", "MigrationStatus", "SQLiteTupleStore"]

@@ -1,0 +1,121 @@
+"""Versioned SQL migrations (reference popx.MigrationBox,
+internal/persistence/sql/persister.go:50-51,71-73 and cmd/migrate).
+
+Migration sources are ``<version>_<name>.up.sql`` / ``.down.sql`` files in a
+directory; applied versions are recorded in ``keto_migrations``. ``up``
+applies pending migrations in version order inside one transaction each;
+``down`` rolls back the most recent N; ``status`` lists every known
+migration with its applied state — the same verbs the reference CLI exposes.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sqlite3
+import time
+from dataclasses import dataclass
+
+_FILE_RE = re.compile(r"^(?P<version>\d+)_(?P<name>.+)\.(?P<dir>up|down)\.sql$")
+
+
+@dataclass(frozen=True)
+class Migration:
+    version: str
+    name: str
+    up_sql: str
+    down_sql: str
+
+
+@dataclass(frozen=True)
+class MigrationStatus:
+    version: str
+    name: str
+    applied: bool
+
+
+def load_migrations(directory: str) -> list[Migration]:
+    found: dict[str, dict] = {}
+    for fname in sorted(os.listdir(directory)):
+        m = _FILE_RE.match(fname)
+        if not m:
+            continue
+        entry = found.setdefault(
+            m.group("version"), {"name": m.group("name"), "up": "", "down": ""}
+        )
+        with open(os.path.join(directory, fname)) as f:
+            entry[m.group("dir")] = f.read()
+    return [
+        Migration(
+            version=v,
+            name=e["name"],
+            up_sql=e["up"],
+            down_sql=e["down"],
+        )
+        for v, e in sorted(found.items())
+    ]
+
+
+class Migrator:
+    TABLE = "keto_migrations"
+
+    def __init__(self, conn: sqlite3.Connection, directory: str):
+        self.conn = conn
+        self.migrations = load_migrations(directory)
+        conn.execute(
+            f"CREATE TABLE IF NOT EXISTS {self.TABLE} ("
+            "version TEXT PRIMARY KEY, name TEXT NOT NULL, "
+            "applied_at REAL NOT NULL)"
+        )
+        conn.commit()
+
+    def applied_versions(self) -> set[str]:
+        rows = self.conn.execute(f"SELECT version FROM {self.TABLE}").fetchall()
+        return {r[0] for r in rows}
+
+    def status(self) -> list[MigrationStatus]:
+        applied = self.applied_versions()
+        return [
+            MigrationStatus(m.version, m.name, m.version in applied)
+            for m in self.migrations
+        ]
+
+    def has_pending(self) -> bool:
+        return any(not s.applied for s in self.status())
+
+    def up(self, steps: int = -1) -> list[str]:
+        """Apply pending migrations (all by default); returns versions run."""
+        applied = self.applied_versions()
+        ran = []
+        for m in self.migrations:
+            if m.version in applied:
+                continue
+            if steps >= 0 and len(ran) >= steps:
+                break
+            with self.conn:  # one transaction per migration, like popx
+                self.conn.executescript(m.up_sql)
+                self.conn.execute(
+                    f"INSERT INTO {self.TABLE} (version, name, applied_at) "
+                    "VALUES (?, ?, ?)",
+                    (m.version, m.name, time.time()),
+                )
+            ran.append(m.version)
+        return ran
+
+    def down(self, steps: int = 1) -> list[str]:
+        """Roll back the most recent `steps` applied migrations."""
+        applied = self.applied_versions()
+        ran = []
+        for m in reversed(self.migrations):
+            if m.version not in applied:
+                continue
+            if len(ran) >= steps:
+                break
+            with self.conn:
+                if m.down_sql:
+                    self.conn.executescript(m.down_sql)
+                self.conn.execute(
+                    f"DELETE FROM {self.TABLE} WHERE version = ?", (m.version,)
+                )
+            ran.append(m.version)
+        return ran

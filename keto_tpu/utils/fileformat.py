@@ -12,18 +12,20 @@ from .errors import ErrMalformedInput
 
 
 def load_structured_file(path: str):
+    """Every parser failure surfaces as ErrMalformedInput so callers handle
+    one exception type regardless of format."""
+    import tomllib
+
     with open(path) as f:
         text = f.read()
-    if path.endswith((".yaml", ".yml")):
-        return yaml.safe_load(text)
-    if path.endswith(".json"):
-        return json.loads(text)
-    if path.endswith(".toml"):
-        import tomllib
-
-        return tomllib.loads(text)
-    # YAML is a JSON superset: sensible default for extensionless files
     try:
+        if path.endswith((".yaml", ".yml")):
+            return yaml.safe_load(text)
+        if path.endswith(".json"):
+            return json.loads(text)
+        if path.endswith(".toml"):
+            return tomllib.loads(text)
+        # YAML is a JSON superset: sensible default for extensionless files
         return yaml.safe_load(text)
-    except yaml.YAMLError as e:
+    except (yaml.YAMLError, json.JSONDecodeError, tomllib.TOMLDecodeError) as e:
         raise ErrMalformedInput(f"cannot parse {path}: {e}") from e

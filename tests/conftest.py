@@ -25,6 +25,17 @@ def nsmgr():
     return MemoryNamespaceManager()
 
 
-@pytest.fixture
-def store(nsmgr):
-    return InMemoryTupleStore(namespace_manager=nsmgr)
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request, nsmgr, tmp_path):
+    """Every contract/engine test runs against both persistence backends —
+    the reference's one-suite-many-DSNs matrix (SURVEY.md §4)."""
+    if request.param == "memory":
+        yield InMemoryTupleStore(namespace_manager=nsmgr)
+        return
+    from keto_tpu.persistence import SQLiteTupleStore
+
+    s = SQLiteTupleStore(
+        str(tmp_path / "keto.db"), namespace_manager=nsmgr
+    )
+    yield s
+    s.close()

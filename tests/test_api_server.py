@@ -42,7 +42,7 @@ class ServerFixture:
         fut = asyncio.run_coroutine_threadsafe(
             self.registry.start_all(), self.loop
         )
-        self.read_port, self.write_port = fut.result(timeout=30)
+        self.read_port, self.write_port = fut.result(timeout=180)
 
     def stop(self):
         asyncio.run_coroutine_threadsafe(

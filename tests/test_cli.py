@@ -1,0 +1,156 @@
+"""CLI tests: the cobra-executor e2e pattern of the reference
+(internal/e2e/cli_client_test.go) — drive the click CLI against a live
+server over real gRPC."""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+from keto_tpu.cli import cli
+from tests.test_api_server import ServerFixture
+from keto_tpu.driver import Config
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = Config(
+        values={
+            "namespaces": [{"id": 1, "name": "videos"}],
+            "serve": {
+                "read": {"port": 0, "host": "127.0.0.1"},
+                "write": {"port": 0, "host": "127.0.0.1"},
+            },
+        }
+    )
+    s = ServerFixture(cfg)
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def runner(server):
+    r = CliRunner()
+    remotes = [
+        "--read-remote", f"127.0.0.1:{server.read_port}",
+        "--write-remote", f"127.0.0.1:{server.write_port}",
+    ]
+    return r, remotes
+
+
+class TestCliFlow:
+    def test_version(self, runner):
+        r, _ = runner
+        res = r.invoke(cli, ["version"])
+        assert res.exit_code == 0
+        assert res.output.strip()
+
+    def test_status(self, runner):
+        r, remotes = runner
+        res = r.invoke(cli, remotes + ["status"])
+        assert res.exit_code == 0, res.output
+        assert "SERVING" in res.output
+
+    def test_parse(self, runner):
+        r, _ = runner
+        res = r.invoke(
+            cli,
+            ["relation-tuple", "parse", "-"],
+            input="// a comment\nvideos:/cats#owner@(cat lady)\n\n",
+        )
+        assert res.exit_code == 0, res.output
+        doc = json.loads(res.output.strip())
+        assert doc == {
+            "namespace": "videos",
+            "object": "/cats",
+            "relation": "owner",
+            "subject_id": "cat lady",
+        }
+
+    def test_create_check_expand_get_delete(self, runner):
+        r, remotes = runner
+        tuples = [
+            {"namespace": "videos", "object": "/cats", "relation": "owner",
+             "subject_id": "cat lady"},
+            {"namespace": "videos", "object": "/cats/1.mp4", "relation": "view",
+             "subject_set": {"namespace": "videos", "object": "/cats",
+                              "relation": "owner"}},
+        ]
+        res = r.invoke(
+            cli,
+            remotes + ["relation-tuple", "create", "-"],
+            input=json.dumps(tuples),
+        )
+        assert res.exit_code == 0, res.output
+        assert "created 2" in res.output
+
+        res = r.invoke(
+            cli,
+            remotes + ["check", "cat lady", "view", "videos", "/cats/1.mp4"],
+        )
+        assert res.exit_code == 0, res.output
+        assert "Allowed" in res.output
+
+        res = r.invoke(
+            cli, remotes + ["check", "dog guy", "view", "videos", "/cats/1.mp4"]
+        )
+        assert res.exit_code == 1
+        assert "Denied" in res.output
+
+        res = r.invoke(
+            cli, remotes + ["expand", "view", "videos", "/cats/1.mp4"]
+        )
+        assert res.exit_code == 0, res.output
+        assert "cat lady" in res.output
+
+        res = r.invoke(
+            cli,
+            remotes + ["relation-tuple", "get", "--namespace", "videos",
+                        "--format", "json"],
+        )
+        assert res.exit_code == 0, res.output
+        listing = json.loads(res.output)
+        assert len(listing["relation_tuples"]) == 2
+
+        res = r.invoke(
+            cli,
+            remotes + ["relation-tuple", "delete-all", "--namespace", "videos",
+                        "--force"],
+        )
+        assert res.exit_code == 0, res.output
+        res = r.invoke(
+            cli,
+            remotes + ["relation-tuple", "get", "--namespace", "videos",
+                        "--format", "json"],
+        )
+        assert json.loads(res.output)["relation_tuples"] == []
+
+    def test_namespace_validate(self, runner, tmp_path):
+        r, _ = runner
+        good = tmp_path / "ns.yml"
+        good.write_text("- name: videos\n  id: 1\n")
+        bad = tmp_path / "bad.yml"
+        bad.write_text("- nope: x\n")
+        res = r.invoke(cli, ["namespace", "validate", str(good)])
+        assert res.exit_code == 0, res.output
+        res = r.invoke(cli, ["namespace", "validate", str(bad)])
+        assert res.exit_code == 1
+
+    def test_migrate_status_sqlite(self, tmp_path):
+        r = CliRunner()
+        cfg = tmp_path / "keto.yml"
+        cfg.write_text(
+            f"dsn: sqlite://{tmp_path}/keto.db\nnamespaces: []\n"
+        )
+        res = r.invoke(cli, ["migrate", "status", "-c", str(cfg)])
+        assert res.exit_code == 0, res.output
+        assert "applied" in res.output
+
+    def test_connection_error(self, runner):
+        r, _ = runner
+        res = r.invoke(
+            cli,
+            ["--read-remote", "127.0.0.1:1", "status"],
+        )
+        assert res.exit_code != 0
+        assert "cannot connect" in res.output
