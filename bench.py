@@ -1,0 +1,157 @@
+"""Benchmark: batched Check throughput on the device engine.
+
+Builds a synthetic RBAC-shaped tuple graph (users -> groups -> roles ->
+resource grants, BASELINE.json's "rbac" config family), then measures
+steady-state batched check RPS through DeviceCheckEngine on whatever
+device JAX gives (real TPU chip under the driver).
+
+Prints ONE json line:
+  {"metric": "check_rps", "value": N, "unit": "checks/s", "vs_baseline": x}
+vs_baseline is relative to the BASELINE.json north star of 1,000,000
+check RPCs/sec (the reference publishes no measured numbers — SURVEY.md §6).
+
+Env knobs: BENCH_TUPLES (default 1_000_000), BENCH_BATCH (default 4096),
+BENCH_ITERS (default 20), BENCH_MODE (auto|dense|scatter).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_rbac_graph(n_tuples: int, rng: np.random.Generator):
+    """users ∈ groups ∈ roles -> per-resource grants, with ~15% subject-set
+    indirection depth beyond 2 (role hierarchies)."""
+    from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+    from keto_tpu.store import InMemoryTupleStore
+
+    n_users = max(n_tuples // 10, 100)
+    n_groups = max(n_tuples // 100, 20)
+    n_roles = max(n_groups // 10, 5)
+    n_resources = max(n_tuples // 3, 50)
+
+    tuples: list[RelationTuple] = []
+    # users -> groups  (~40%)
+    for _ in range(int(n_tuples * 0.4)):
+        tuples.append(
+            RelationTuple(
+                "rbac", f"g{rng.integers(n_groups)}", "member",
+                SubjectID(f"u{rng.integers(n_users)}"),
+            )
+        )
+    # groups -> roles (~10%)
+    for _ in range(int(n_tuples * 0.1)):
+        tuples.append(
+            RelationTuple(
+                "rbac", f"role{rng.integers(n_roles)}", "member",
+                SubjectSet("rbac", f"g{rng.integers(n_groups)}", "member"),
+            )
+        )
+    # role hierarchy (~5%)
+    for _ in range(int(n_tuples * 0.05)):
+        a, b = rng.integers(n_roles, size=2)
+        tuples.append(
+            RelationTuple(
+                "rbac", f"role{a}", "member",
+                SubjectSet("rbac", f"role{b}", "member"),
+            )
+        )
+    # resource grants -> roles or groups (~45%)
+    while len(tuples) < n_tuples:
+        r = rng.integers(n_resources)
+        if rng.random() < 0.5:
+            sub = SubjectSet("rbac", f"role{rng.integers(n_roles)}", "member")
+        else:
+            sub = SubjectSet("rbac", f"g{rng.integers(n_groups)}", "member")
+        tuples.append(RelationTuple("rbac", f"res{r}", "view", sub))
+
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(*tuples)
+    return store, n_users, n_resources
+
+
+def main():
+    n_tuples = int(os.environ.get("BENCH_TUPLES", 1_000_000))
+    batch = int(os.environ.get("BENCH_BATCH", 4096))
+    iters = int(os.environ.get("BENCH_ITERS", 20))
+    mode = os.environ.get("BENCH_MODE", "auto")
+
+    import jax
+
+    from keto_tpu.engine.device import DeviceCheckEngine
+    from keto_tpu.graph import SnapshotManager
+    from keto_tpu.relationtuple import RelationTuple, SubjectID
+
+    rng = np.random.default_rng(7)
+    t0 = time.time()
+    store, n_users, n_resources = build_rbac_graph(n_tuples, rng)
+    t_build = time.time() - t0
+
+    t0 = time.time()
+    snapshots = SnapshotManager(store)
+    snap = snapshots.snapshot()
+    t_encode = time.time() - t0
+
+    engine = DeviceCheckEngine(snapshots, max_depth=5, mode=mode)
+
+    # request mix: resource-view checks for random users (the Zanzibar hot
+    # query), ~70% expected denials like production check traffic
+    def make_requests(k):
+        return [
+            RelationTuple(
+                "rbac", f"res{rng.integers(n_resources)}", "view",
+                SubjectID(f"u{rng.integers(n_users)}"),
+            )
+            for _ in range(k)
+        ]
+
+    warm = make_requests(batch)
+    t0 = time.time()
+    engine.batch_check(warm)  # compile
+    t_compile = time.time() - t0
+    engine.batch_check(warm)  # steady-state warm
+
+    batches = [make_requests(batch) for _ in range(iters)]
+    t0 = time.time()
+    n_allowed = 0
+    for reqs in batches:
+        res = engine.batch_check(reqs)
+        n_allowed += sum(res)
+    elapsed = time.time() - t0
+    rps = batch * iters / elapsed
+
+    meta = {
+        "tuples": n_tuples,
+        "nodes": snap.num_nodes,
+        "padded_nodes": snap.padded_nodes,
+        "padded_edges": snap.padded_edges,
+        "batch": batch,
+        "iters": iters,
+        "device": str(jax.devices()[0]),
+        "mode": "dense" if engine._device_graph(snap).dense else "scatter",
+        "build_s": round(t_build, 2),
+        "encode_s": round(t_encode, 2),
+        "compile_s": round(t_compile, 2),
+        "allowed_frac": round(n_allowed / (batch * iters), 3),
+        "batch_latency_ms": round(1000 * elapsed / iters, 2),
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "check_rps",
+                "value": round(rps),
+                "unit": "checks/s",
+                "vs_baseline": round(rps / 1_000_000, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

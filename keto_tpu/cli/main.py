@@ -113,7 +113,7 @@ def serve(ctx, config_file):
 def check(ctx, subject, relation, namespace, object, max_depth, fmt):
     """Check whether SUBJECT has RELATION on NAMESPACE:OBJECT
     (reference cmd/check/root.go:27-72)."""
-    from ..api import acl_pb2, check_service_pb2
+    from ..api import check_service_pb2
     from ..api.convert import subject_to_proto
     from ..api.services import CheckServiceStub
     from ..relationtuple.definitions import subject_from_string
@@ -357,15 +357,17 @@ def parse(sources):
 
 
 def _store_for_migrate(config_file):
-    from ..driver import Config, Registry
+    from ..driver import Config
 
-    registry = Registry(Config(config_file=config_file))
-    store = registry.store()
-    if not hasattr(store, "migrator"):
+    dsn = Config(config_file=config_file).dsn()
+    if not dsn.startswith("sqlite://") or dsn == "sqlite://:memory:":
         raise click.ClickException(
             "DSN has no migrations (the in-memory store migrates implicitly)"
         )
-    return store
+    from ..persistence import SQLiteTupleStore
+
+    # no auto-migrate: these commands exist to inspect and apply explicitly
+    return SQLiteTupleStore(dsn[len("sqlite://"):], auto_migrate=False)
 
 
 @cli.group()
@@ -431,7 +433,7 @@ def validate(files):
         try:
             nss = parse_namespace_file(f)
             click.echo(f"{f}: OK ({len(nss)} namespaces)")
-        except (ErrMalformedInput, Exception) as e:  # noqa: BLE001
+        except (ErrMalformedInput, OSError) as e:
             failed = True
             click.echo(f"{f}: INVALID — {e}", err=True)
     if failed:
@@ -461,6 +463,10 @@ def status(ctx, block, timeout_s):
             click.echo(name)
             if resp.status == health_pb2.HealthCheckResponse.SERVING or not block:
                 return
+        except grpc.RpcError as e:
+            if not block:
+                _fail_rpc(e)
+            click.echo("NOT_REACHABLE")
         except click.ClickException:
             if not block:
                 raise

@@ -1,0 +1,13 @@
+"""Multi-chip scale-out: the tuple graph sharded over a device mesh.
+
+The reference scales horizontally with stateless replicas over a shared SQL
+database and delegates data distribution to CockroachDB (SURVEY.md §2.10).
+The TPU-native equivalent: shard the edge arrays over an ICI mesh with
+``jax.sharding`` + ``shard_map``, exchange frontiers with XLA collectives
+per expansion step, and keep the whole depth loop inside one compiled
+program — no host round-trips between steps.
+"""
+
+from .sharded import ShardedCheckEngine, make_mesh, sharded_check
+
+__all__ = ["ShardedCheckEngine", "make_mesh", "sharded_check"]

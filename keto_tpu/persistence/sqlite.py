@@ -69,7 +69,6 @@ class SQLiteTupleStore(Manager):
     ):
         self.path = path or ":memory:"
         self.namespace_manager = namespace_manager
-        self.network_id = network_id or str(uuid.uuid4())
         self._lock = threading.RLock()
         self._conn = sqlite3.connect(self.path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
@@ -79,8 +78,34 @@ class SQLiteTupleStore(Manager):
         self.migrator = Migrator(self._conn, _MIGRATIONS_DIR)
         if auto_migrate:
             self.migrator.up()
+        if network_id is not None:
+            self.network_id = network_id
+        else:
+            self.network_id = self._determine_network()
         self._listeners: list[Callable[[int], None]] = []
         self._delta_listeners: list[Callable] = []
+
+    def _determine_network(self) -> str:
+        """Adopt the database's oldest network, creating one on a fresh
+        database — a restarted server keeps seeing its own rows (reference
+        determineNetwork, registry_default.go:207-225)."""
+        try:
+            row = self._conn.execute(
+                "SELECT id FROM keto_networks ORDER BY created_at LIMIT 1"
+            ).fetchone()
+        except sqlite3.OperationalError:
+            # migrations not applied yet (auto_migrate=False): ephemeral id;
+            # re-determined once the operator migrates and reopens
+            return str(uuid.uuid4())
+        if row is not None:
+            return row[0]
+        nid = str(uuid.uuid4())
+        with self._conn:
+            self._conn.execute(
+                "INSERT INTO keto_networks (id, created_at) VALUES (?, ?)",
+                (nid, time.time()),
+            )
+        return nid
 
     # -- version / change feed (same surface as InMemoryTupleStore) -----------
 

@@ -1,8 +1,14 @@
 """Test configuration.
 
-Tests run on CPU with 8 virtual devices so multi-chip sharding
-(keto_tpu/parallel) is exercised without TPU hardware; set before any jax
-import.
+Backend note: on this machine an axon sitecustomize imports jax at
+interpreter start and pins the single real TPU chip — env tweaks here can no
+longer change the backend, so the main suite runs on whatever the
+interpreter started with (TPU under axon, CPU elsewhere). Multi-device
+sharding tests (test_multichip_sharded.py) need an 8-device CPU mesh and are
+driven through a subprocess with
+``PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8`` set at interpreter
+start (see test_sharded_subprocess.py).
 """
 
 import os

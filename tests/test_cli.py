@@ -136,15 +136,25 @@ class TestCliFlow:
         res = r.invoke(cli, ["namespace", "validate", str(bad)])
         assert res.exit_code == 1
 
-    def test_migrate_status_sqlite(self, tmp_path):
+    def test_migrate_status_up_flow(self, tmp_path):
         r = CliRunner()
         cfg = tmp_path / "keto.yml"
         cfg.write_text(
             f"dsn: sqlite://{tmp_path}/keto.db\nnamespaces: []\n"
         )
+        # fresh DB: everything pending (migrate commands never auto-apply)
         res = r.invoke(cli, ["migrate", "status", "-c", str(cfg)])
         assert res.exit_code == 0, res.output
+        assert "pending" in res.output
+        res = r.invoke(cli, ["migrate", "up", "-c", str(cfg), "--yes"])
+        assert res.exit_code == 0, res.output
         assert "applied" in res.output
+        res = r.invoke(cli, ["migrate", "status", "-c", str(cfg)])
+        assert "pending" not in res.output
+        res = r.invoke(cli, ["migrate", "down", "1", "-c", str(cfg), "--yes"])
+        assert res.exit_code == 0, res.output
+        res = r.invoke(cli, ["migrate", "status", "-c", str(cfg)])
+        assert "pending" in res.output
 
     def test_connection_error(self, runner):
         r, _ = runner

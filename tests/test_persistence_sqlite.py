@@ -69,6 +69,23 @@ class TestDurability:
         s2.close()
 
 
+class TestDefaultNetworkDurability:
+    def test_server_path_without_explicit_nid_survives_restart(self, tmp_path, nsmgr):
+        # the serve path passes no network_id; the store must adopt the
+        # database's network on reopen (reference determineNetwork,
+        # registry_default.go:207-225)
+        path = str(tmp_path / "srv.db")
+        s = SQLiteTupleStore(path, namespace_manager=nsmgr)
+        s.write_relation_tuples(t("n:o#r@alice"))
+        nid = s.network_id
+        s.close()
+        s2 = SQLiteTupleStore(path, namespace_manager=nsmgr)
+        assert s2.network_id == nid
+        assert s2.all_tuples() == [t("n:o#r@alice")]
+        assert s2.version == 1
+        s2.close()
+
+
 class TestIsolation:
     def test_two_networks_one_database(self, tmp_path, nsmgr):
         # reference manager_isolation.go:44-138: two persisters with

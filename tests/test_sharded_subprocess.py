@@ -1,0 +1,40 @@
+"""Drives the multi-device sharding tests under an 8-device virtual CPU
+mesh. The axon sitecustomize pins the backend at interpreter start, so the
+mesh tests need a fresh interpreter with the right env (see conftest note)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.timeout(600)
+def test_multichip_suite_on_virtual_mesh():
+    env = dict(os.environ)
+    env.update(
+        {
+            "PALLAS_AXON_POOL_IPS": "",  # skip axon registration
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            os.path.join(os.path.dirname(__file__), "test_multichip_sharded.py"),
+            "-q",
+            "--no-header",
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=570,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, (
+        f"multichip suite failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "skipped" not in proc.stdout.lower() or "passed" in proc.stdout
