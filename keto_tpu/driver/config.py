@@ -35,6 +35,8 @@ KEY_WRITE_HOST = "serve.write.host"
 KEY_READ_MAX_DEPTH = "serve.read.max-depth"  # reference provider.go:32
 KEY_NAMESPACES = "namespaces"
 
+_UNSET = object()  # sentinel so falsy explicit defaults (0/False/"") are honored
+
 _CORS_SCHEMA = {
     "type": "object",
     "properties": {
@@ -178,7 +180,7 @@ class Config:
 
     # -- lookup ---------------------------------------------------------------
 
-    def get(self, key: str, default: Any = None) -> Any:
+    def get(self, key: str, default: Any = _UNSET) -> Any:
         if key in self._overrides:
             return self._overrides[key]
         env_val = self._env.get("KETO_" + _flatten_env_key(key))
@@ -189,7 +191,8 @@ class Config:
         node: Any = self._data
         for part in key.split("."):
             if not isinstance(node, dict) or part not in node:
-                if default is not None:
+                # a caller-provided default wins even when falsy (0/False/"")
+                if default is not _UNSET:
                     return default
                 return DEFAULTS.get(key)
             node = node[part]

@@ -253,6 +253,9 @@ class SnapshotExpandEngine:
         for child_nid in successors:
             child_subject = snap.vocab.subject_of(int(child_nid))
             child = self._expand(snap, child_subject, rest_depth - 1, visited)
-            if child is not None:
-                children.append(child)
+            if child is None:
+                # nil child (visited cycle / set with no tuples) degrades to a
+                # Leaf for that subject, never dropped (engine.go:80-86)
+                child = Tree(type=NodeType.LEAF, subject=child_subject)
+            children.append(child)
         return Tree(type=NodeType.UNION, subject=subject, children=children)

@@ -68,6 +68,9 @@ class ExpandEngine:
         children = []
         for r in rels:
             child = self._build(r.subject, rest_depth - 1, visited)
-            if child is not None:
-                children.append(child)
+            if child is None:
+                # nil child (visited cycle / set with no tuples) degrades to a
+                # Leaf for that subject, never dropped (engine.go:80-86)
+                child = Tree(type=NodeType.LEAF, subject=r.subject)
+            children.append(child)
         return Tree(type=NodeType.UNION, subject=subject, children=children)

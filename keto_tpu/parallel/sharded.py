@@ -158,13 +158,12 @@ class ShardedCheckEngine:
             return dev_src, dev_dst
 
     def _bucket_batch(self, n: int) -> int:
-        # batch must divide evenly across the data axis
-        lcm = self.n_data
-        b = max(n, 8, lcm)
-        b = 1 << (b - 1).bit_length()
-        while b % lcm:
-            b *= 2
-        return b
+        # batch must divide evenly across the data axis: bucket the
+        # per-device slice to a power of two, then multiply back out (works
+        # for any n_data, including non-powers of two)
+        per_device = -(-max(n, 8) // self.n_data)
+        per_device = 1 << (per_device - 1).bit_length()
+        return per_device * self.n_data
 
     def batch_check(
         self,

@@ -18,6 +18,14 @@ from dataclasses import dataclass
 
 _FILE_RE = re.compile(r"^(?P<version>\d+)_(?P<name>.+)\.(?P<dir>up|down)\.sql$")
 
+# bare transaction-control statements inside a migration script (we run the
+# whole script in one transaction ourselves)
+_TXN_CONTROL_RE = re.compile(
+    r"(?:BEGIN|COMMIT|END|ROLLBACK)(?:\s+(?:TRANSACTION|DEFERRED|IMMEDIATE|"
+    r"EXCLUSIVE))?\s*;?",
+    re.IGNORECASE,
+)
+
 
 @dataclass(frozen=True)
 class Migration:
@@ -83,6 +91,35 @@ class Migrator:
     def has_pending(self) -> bool:
         return any(not s.applied for s in self.status())
 
+    def _run_in_transaction(self, script: str, record_sql: str, params) -> None:
+        """Execute a migration script statement-by-statement plus its version
+        bookkeeping row in ONE explicit transaction. ``executescript`` is
+        unusable here: it issues an implicit COMMIT before running, so a
+        failing multi-statement migration would leave partial DDL applied
+        with no version row recorded."""
+        old_isolation = self.conn.isolation_level
+        self.conn.isolation_level = None  # autocommit: we manage the txn
+        try:
+            self.conn.execute("BEGIN")
+            try:
+                for stmt in _split_statements(script):
+                    # scripts written defensively with their own txn control
+                    # (BEGIN; ...; COMMIT;) run inside OUR transaction
+                    if _TXN_CONTROL_RE.fullmatch(stmt):
+                        continue
+                    self.conn.execute(stmt)
+                self.conn.execute(record_sql, params)
+                self.conn.execute("COMMIT")
+            except BaseException:
+                # a statement may have auto-rolled-back already (e.g. INSERT
+                # OR ROLLBACK, RAISE(ROLLBACK)); rolling back a closed txn
+                # would mask the original error
+                if self.conn.in_transaction:
+                    self.conn.execute("ROLLBACK")
+                raise
+        finally:
+            self.conn.isolation_level = old_isolation
+
     def up(self, steps: int = -1) -> list[str]:
         """Apply pending migrations (all by default); returns versions run."""
         applied = self.applied_versions()
@@ -92,13 +129,13 @@ class Migrator:
                 continue
             if steps >= 0 and len(ran) >= steps:
                 break
-            with self.conn:  # one transaction per migration, like popx
-                self.conn.executescript(m.up_sql)
-                self.conn.execute(
-                    f"INSERT INTO {self.TABLE} (version, name, applied_at) "
-                    "VALUES (?, ?, ?)",
-                    (m.version, m.name, time.time()),
-                )
+            # one transaction per migration, like popx
+            self._run_in_transaction(
+                m.up_sql,
+                f"INSERT INTO {self.TABLE} (version, name, applied_at) "
+                "VALUES (?, ?, ?)",
+                (m.version, m.name, time.time()),
+            )
             ran.append(m.version)
         return ran
 
@@ -111,11 +148,28 @@ class Migrator:
                 continue
             if len(ran) >= steps:
                 break
-            with self.conn:
-                if m.down_sql:
-                    self.conn.executescript(m.down_sql)
-                self.conn.execute(
-                    f"DELETE FROM {self.TABLE} WHERE version = ?", (m.version,)
-                )
+            self._run_in_transaction(
+                m.down_sql,
+                f"DELETE FROM {self.TABLE} WHERE version = ?",
+                (m.version,),
+            )
             ran.append(m.version)
         return ran
+
+
+def _split_statements(script: str):
+    """Split a SQL script into complete statements using sqlite's own
+    statement-completeness test (handles BEGIN..END trigger bodies and
+    semicolons inside string literals; multiple statements per line are
+    split correctly because candidates grow semicolon-by-semicolon)."""
+    buf = ""
+    for piece in script.split(";"):
+        buf += piece + ";"
+        if sqlite3.complete_statement(buf):
+            stmt = buf.strip()
+            if stmt and stmt != ";":
+                yield stmt
+            buf = ""
+    tail = buf.strip().rstrip(";").strip()
+    if tail:
+        yield tail + ";"

@@ -1,0 +1,53 @@
+"""Config provider regression tests (falsy defaults, env/flag precedence)."""
+
+from keto_tpu.driver.config import Config
+
+
+class TestConfigDefaults:
+    def test_explicit_falsy_defaults_are_honored(self):
+        c = Config(values={}, env={})
+        # a caller-provided falsy default must not fall through to DEFAULTS
+        assert c.get("engine.batch_window_us", default=0) == 0
+        assert c.get("serve.read.port", default=0) == 0
+        assert c.get("log.level", default="") == ""
+        assert c.get("namespaces", default=False) is False
+
+    def test_missing_key_without_default_uses_defaults_table(self):
+        c = Config(values={}, env={})
+        assert c.get("serve.read.port") == 4466
+        assert c.get("engine.mode") == "device"
+        assert c.get("no.such.key") is None
+
+    def test_data_value_wins_over_default(self):
+        c = Config(values={"serve": {"read": {"port": 1234}}}, env={})
+        assert c.get("serve.read.port", default=0) == 1234
+
+    def test_env_override_wins(self):
+        c = Config(values={}, env={"KETO_SERVE_READ_PORT": "9999"})
+        assert c.get("serve.read.port", default=0) == 9999
+
+    def test_flag_override_wins_over_env(self):
+        c = Config(
+            values={},
+            env={"KETO_SERVE_READ_PORT": "9999"},
+            flag_overrides={"serve.read.port": 1111},
+        )
+        assert c.get("serve.read.port") == 1111
+
+
+class TestShardedBucket:
+    def test_bucket_batch_terminates_for_non_power_of_two_data_axis(self):
+        from keto_tpu.parallel.sharded import ShardedCheckEngine
+
+        class Dummy:
+            pass
+
+        for n_data in (1, 2, 3, 5, 6, 7, 8):
+            eng = Dummy()
+            eng.n_data = n_data
+            for n in (1, 7, 8, 9, 100, 4096):
+                b = ShardedCheckEngine._bucket_batch(eng, n)
+                assert b >= n
+                assert b % n_data == 0
+                per = b // n_data
+                assert per & (per - 1) == 0  # per-device slice is a pow2

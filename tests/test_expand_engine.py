@@ -85,17 +85,20 @@ class TestExpandEngine:
         tree = e.build_tree(root, 100)
         assert subjects_of(tree) == set(users)
 
-    def test_subject_set_without_tuples_is_dropped(self):
+    def test_subject_set_without_tuples_becomes_leaf_child(self):
         store, e = make_env("n")
         root = SubjectSet("n", "obj", "access")
         store.write_relation_tuples(
             T("n", "obj", "access", SubjectSet("n", "empty", "member")),
         )
         tree = e.build_tree(root, 100)
-        # reference returns nil for an empty subject set (engine.go:67-69),
-        # so the child list is empty
+        # reference returns nil for an empty subject set (engine.go:67-69) but
+        # the parent substitutes a Leaf for the nil child (engine.go:80-86)
         assert tree.type == NodeType.UNION
-        assert tree.children == []
+        (child,) = tree.children
+        assert child == Tree(
+            type=NodeType.LEAF, subject=SubjectSet("n", "empty", "member")
+        )
 
     def test_circular_tuples_terminate(self):
         store, e = make_env("m")
@@ -105,11 +108,16 @@ class TestExpandEngine:
             T("m", b, "connected", SubjectSet("m", a, "connected")),
         )
         tree = e.build_tree(SubjectSet("m", a, "connected"), 100)
-        # A expands to B; B's expansion of A is suppressed by the visited set
+        # A expands to B; B's re-expansion of A is suppressed by the visited
+        # set, degrading to a Leaf child (engine.go:80-86) — never dropped
         assert tree.type == NodeType.UNION
         (child,) = tree.children
         assert child.subject == SubjectSet("m", b, "connected")
-        assert child.children == []
+        assert child.type == NodeType.UNION
+        (grandchild,) = child.children
+        assert grandchild == Tree(
+            type=NodeType.LEAF, subject=SubjectSet("m", a, "connected")
+        )
 
     def test_unknown_namespace_returns_none(self):
         _, e = make_env("known")

@@ -40,6 +40,35 @@ class TestMigrations:
         assert not s.migrator.has_pending()
         s.close()
 
+    def test_failing_migration_rolls_back_completely(self, tmp_path):
+        """A failing multi-statement migration must leave no partial DDL and
+        no version row (executescript would have committed implicitly)."""
+        import sqlite3
+
+        from keto_tpu.persistence.migrator import Migrator
+
+        mdir = tmp_path / "migrations"
+        mdir.mkdir()
+        (mdir / "001_bad.up.sql").write_text(
+            "CREATE TABLE good_one (id INTEGER PRIMARY KEY);\n"
+            "CREATE TABLE bad one (syntax error here;\n"
+        )
+        (mdir / "001_bad.down.sql").write_text("DROP TABLE good_one;\n")
+        conn = sqlite3.connect(str(tmp_path / "rb.db"))
+        m = Migrator(conn, str(mdir))
+        with pytest.raises(sqlite3.OperationalError):
+            m.up()
+        # the first statement's table must have been rolled back
+        tables = {
+            r[0]
+            for r in conn.execute(
+                "SELECT name FROM sqlite_master WHERE type='table'"
+            )
+        }
+        assert "good_one" not in tables
+        assert m.applied_versions() == set()
+        conn.close()
+
     def test_down_then_up_roundtrip(self, tmp_path, nsmgr):
         s = SQLiteTupleStore(str(tmp_path / "m.db"), namespace_manager=nsmgr)
         n_all = len(s.migrator.status())
