@@ -25,6 +25,14 @@ _TXN_CONTROL_RE = re.compile(
     r"EXCLUSIVE))?\s*;?",
     re.IGNORECASE,
 )
+_LEADING_SQL_COMMENTS_RE = re.compile(r"(?s)^(?:\s*(?:--[^\n]*\n?|/\*.*?\*/))*")
+
+
+def _is_txn_control(stmt: str) -> bool:
+    """True for a bare BEGIN/COMMIT/END/ROLLBACK statement, ignoring any
+    leading SQL comments attached to it by the statement splitter."""
+    bare = _LEADING_SQL_COMMENTS_RE.sub("", stmt, count=1).strip()
+    return _TXN_CONTROL_RE.fullmatch(bare) is not None
 
 
 @dataclass(frozen=True)
@@ -97,6 +105,12 @@ class Migrator:
         unusable here: it issues an implicit COMMIT before running, so a
         failing multi-statement migration would leave partial DDL applied
         with no version row recorded."""
+        if self.conn.in_transaction:
+            # assigning isolation_level below would silently COMMIT the
+            # caller's pending writes; refuse instead of surprising them
+            raise RuntimeError(
+                "cannot run migrations: connection has an open transaction"
+            )
         old_isolation = self.conn.isolation_level
         self.conn.isolation_level = None  # autocommit: we manage the txn
         try:
@@ -105,7 +119,7 @@ class Migrator:
                 for stmt in _split_statements(script):
                     # scripts written defensively with their own txn control
                     # (BEGIN; ...; COMMIT;) run inside OUR transaction
-                    if _TXN_CONTROL_RE.fullmatch(stmt):
+                    if _is_txn_control(stmt):
                         continue
                     self.conn.execute(stmt)
                 self.conn.execute(record_sql, params)

@@ -33,21 +33,3 @@ class TestConfigDefaults:
             flag_overrides={"serve.read.port": 1111},
         )
         assert c.get("serve.read.port") == 1111
-
-
-class TestShardedBucket:
-    def test_bucket_batch_terminates_for_non_power_of_two_data_axis(self):
-        from keto_tpu.parallel.sharded import ShardedCheckEngine
-
-        class Dummy:
-            pass
-
-        for n_data in (1, 2, 3, 5, 6, 7, 8):
-            eng = Dummy()
-            eng.n_data = n_data
-            for n in (1, 7, 8, 9, 100, 4096):
-                b = ShardedCheckEngine._bucket_batch(eng, n)
-                assert b >= n
-                assert b % n_data == 0
-                per = b // n_data
-                assert per & (per - 1) == 0  # per-device slice is a pow2
