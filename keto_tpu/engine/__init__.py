@@ -1,9 +1,11 @@
 from .check import CheckEngine, DEFAULT_MAX_DEPTH, clamp_depth
+from .closure import ClosureCheckEngine
 from .expand import ExpandEngine
 from .tree import NodeType, Tree
 
 __all__ = [
     "CheckEngine",
+    "ClosureCheckEngine",
     "DEFAULT_MAX_DEPTH",
     "ExpandEngine",
     "NodeType",

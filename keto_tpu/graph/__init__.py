@@ -8,6 +8,7 @@ COO/CSR arrays that the batched check/expand kernels (keto_tpu.ops) consume.
 
 from .vocab import NodeVocab, id_key, set_key
 from .snapshot import GraphSnapshot, SnapshotBuilder, SnapshotManager
+from .interior import InteriorGraph, build_interior, gather_padded_rows
 
 __all__ = [
     "NodeVocab",
@@ -16,4 +17,7 @@ __all__ = [
     "GraphSnapshot",
     "SnapshotBuilder",
     "SnapshotManager",
+    "InteriorGraph",
+    "build_interior",
+    "gather_padded_rows",
 ]

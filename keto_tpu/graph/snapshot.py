@@ -122,14 +122,22 @@ class SnapshotBuilder:
         self, tuples: Sequence[RelationTuple], version: int
     ) -> GraphSnapshot:
         vocab = self.vocab
-        intern = vocab.intern
-        src_ids = np.empty(len(tuples), dtype=np.int32)
-        dst_ids = np.empty(len(tuples), dtype=np.int32)
-        for i, t in enumerate(tuples):
-            src_ids[i] = intern((t.namespace, t.object, t.relation))
-            dst_ids[i] = intern(subject_node_key(t.subject))
+        # bulk-interned (vectorized) encode: two C-speed passes instead of a
+        # per-tuple Python loop — the difference between seconds and minutes
+        # at the 10M-tuple bench configs
+        src_keys = [(t.namespace, t.object, t.relation) for t in tuples]
+        dst_keys = [subject_node_key(t.subject) for t in tuples]
+        src_ids = vocab.intern_bulk(src_keys)
+        dst_ids = vocab.intern_bulk(dst_keys)
+        return self.build_from_ids(src_ids, dst_ids, version)
+
+    def build_from_ids(
+        self, src_ids: np.ndarray, dst_ids: np.ndarray, version: int
+    ) -> GraphSnapshot:
+        """Fast path when edges are already vocab-encoded (columnar store)."""
+        vocab = self.vocab
         n = len(vocab)
-        e = len(tuples)
+        e = len(src_ids)
         padded_nodes = _bucket(n + 1, self.min_nodes)
         padded_edges = _bucket(e, self.min_edges)
         dummy = padded_nodes - 1
@@ -158,6 +166,11 @@ class SnapshotManager:
     new nodes); anything else (deletes, capacity growth, out-of-order
     notifications) marks the snapshot dirty and the next read rebuilds.
     """
+
+    @property
+    def store(self):
+        """The write-side source of truth this manager mirrors."""
+        return self._store
 
     def __init__(
         self,

@@ -16,7 +16,9 @@ without re-encoding the whole graph.
 
 from __future__ import annotations
 
-from typing import Hashable, Optional
+from typing import Hashable, Optional, Sequence
+
+import numpy as np
 
 from ..relationtuple.definitions import Subject, SubjectID, SubjectSet
 
@@ -45,6 +47,7 @@ class NodeVocab:
     def __init__(self) -> None:
         self._id_of: dict[NodeKey, int] = {}
         self._key_of: list[NodeKey] = []
+        self._is_set_cache: Optional[np.ndarray] = None
 
     def __len__(self) -> int:
         return len(self._key_of)
@@ -56,6 +59,38 @@ class NodeVocab:
             self._id_of[key] = nid
             self._key_of.append(key)
         return nid
+
+    def intern_bulk(self, keys: Sequence[NodeKey]) -> np.ndarray:
+        """Vectorized intern of many keys -> int32 ids. Existing keys resolve
+        via one C-speed map() pass; only genuinely new keys take the slow
+        per-key insert path."""
+        get = self._id_of.get
+        ids = list(map(get, keys))
+        out = np.array(
+            [v if v is not None else -1 for v in ids], dtype=np.int32
+        )
+        if len(out) and out.min() < 0:
+            intern = self.intern
+            for i in np.nonzero(out < 0)[0]:
+                out[i] = intern(keys[i])
+        return out
+
+    def is_set_array(self) -> np.ndarray:
+        """bool[len(self)]: True where the node denotes a subject set
+        (3-tuple key), False for subject ids (1-tuple key). Cached; extended
+        incrementally as the vocab grows."""
+        n = len(self._key_of)
+        cache = self._is_set_cache
+        if cache is None or len(cache) != n:
+            start = 0 if cache is None else len(cache)
+            fresh = np.fromiter(
+                (len(k) == 3 for k in self._key_of[start:]),
+                dtype=bool,
+                count=n - start,
+            )
+            cache = fresh if cache is None else np.concatenate([cache, fresh])
+            self._is_set_cache = cache
+        return cache
 
     def lookup(self, key: NodeKey) -> Optional[int]:
         return self._id_of.get(key)
@@ -80,4 +115,5 @@ class NodeVocab:
         v = NodeVocab()
         v._id_of = dict(self._id_of)
         v._key_of = list(self._key_of)
+        v._is_set_cache = None
         return v

@@ -15,6 +15,13 @@ from .frontier import (
     build_dense_adjacency,
     pick_edge_chunk,
 )
+from .closure import (
+    INF_DIST,
+    build_closure,
+    build_closure_packed,
+    closure_query,
+    pack_adjacency,
+)
 
 __all__ = [
     "batched_check_dense",
@@ -23,4 +30,9 @@ __all__ = [
     "batched_distances_scatter",
     "build_dense_adjacency",
     "pick_edge_chunk",
+    "INF_DIST",
+    "build_closure",
+    "build_closure_packed",
+    "closure_query",
+    "pack_adjacency",
 ]

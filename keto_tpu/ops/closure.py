@@ -1,0 +1,124 @@
+"""Bounded all-pairs-distance closure over the interior graph — MXU path.
+
+The interior subgraph (keto_tpu.graph.interior) is small enough to hold as a
+dense adjacency, so depth-bounded all-pairs distances are computed once per
+snapshot with iterated bf16 matmuls on the systolic array:
+
+    reach_{<=k} = reach_{<=k-1}  OR  (reach_{<=k-1} @ A)
+    D[i, j]     = first k at which j becomes reachable from i   (uint8)
+
+After the closure is resident, a whole Check batch costs only gathers
+(ops equivalent of the reference's recursive SQL walk,
+internal/check/engine.go:82-114, collapsed into a table lookup):
+
+    allowed(b) = direct(b)  OR  min_{s in F0(b), s' in L(b)} D[s, s']
+                 + 1 + extra(b)  <=  depth(b)
+
+with F0 = interior successors of the start node, L = interior in-neighbors
+of the target (or the target itself when it is a set), extra = 1 for
+subject-id targets (the final s' -> target hop), 0 for set targets.
+
+Transfer discipline: host<->device hops can be expensive (PCIe at best, a
+network tunnel at worst), so the adjacency ships BITPACKED (1 bit/edge-slot,
+8x smaller than uint8) and is expanded on device. The query-side gather
+exists in two forms: `closure_query` (jit, for devices with cheap
+dispatch) and the engine's host-side numpy twin for latency-dominated
+links (keto_tpu/engine/closure.py decides per deployment).
+
+Shapes are static per (m_pad, k_max) — the closure build compiles once per
+snapshot width bucket. D's padding rows/columns stay at INF (255) so a
+padded index can never produce a spurious allow.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+INF_DIST = 255  # uint8 sentinel: not reachable within the depth bound
+
+
+def pack_adjacency(ii_src, ii_dst, m_pad: int):
+    """Host-side: COO interior edges -> bitpacked rows uint8[m_pad, m_pad/8].
+
+    m_pad must be a multiple of 8 (the engine buckets to 256).
+    """
+    import numpy as np
+
+    adj = np.zeros((m_pad, m_pad), dtype=np.uint8)
+    if len(ii_src):
+        adj[ii_src, ii_dst] = 1
+    return np.packbits(adj, axis=1)
+
+
+@partial(jax.jit, static_argnames=("m_pad", "k_max"))
+def build_closure_packed(packed, m, *, m_pad, k_max):
+    """D: uint8[m_pad, m_pad] bounded shortest-path matrix.
+
+    packed: uint8[m_pad, m_pad/8] bitpacked adjacency rows (pack_adjacency);
+    m: live interior count (dynamic — avoids a recompile per write);
+    k_max: longest path length to resolve (global max-depth - 1).
+    """
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)  # np.packbits bit order
+    adj_bits = (packed[:, :, None] >> shifts[None, None, :]) & jnp.uint8(1)
+    adj = adj_bits.reshape(m_pad, m_pad).astype(jnp.bfloat16)
+    return _closure_from_dense(adj, m, m_pad, k_max)
+
+
+@partial(jax.jit, static_argnames=("m_pad", "k_max"))
+def build_closure(adj, m, *, m_pad, k_max):
+    """As build_closure_packed but from a dense bf16 adjacency (tests)."""
+    return _closure_from_dense(adj, m, m_pad, k_max)
+
+
+def _closure_from_dense(adj, m, m_pad, k_max):
+    inf = jnp.uint8(INF_DIST)
+    reach = adj > 0.5
+    d = jnp.where(reach, jnp.uint8(1), inf)
+
+    def body(k, state):
+        reach, d = state
+        nxt = (
+            jnp.dot(
+                reach.astype(jnp.bfloat16),
+                adj,
+                preferred_element_type=jnp.float32,
+            )
+            > 0.5
+        )
+        newly = jnp.logical_and(nxt, ~reach)
+        d = jnp.where(newly, k.astype(jnp.uint8), d)
+        return jnp.logical_or(reach, nxt), d
+
+    if k_max >= 2:
+        _, d = lax.fori_loop(2, k_max + 1, body, (reach, d))
+
+    # diagonal = 0 (s == s' costs no interior steps) — but only for live
+    # rows; padding diag stays INF so the PAD index is inert in queries
+    idx = jnp.arange(m_pad, dtype=jnp.int32)
+    live = idx < m
+    eye = idx[:, None] == idx[None, :]
+    diag_vals = jnp.where(live, jnp.uint8(0), inf)
+    return jnp.where(eye, diag_vals[:, None], d)
+
+
+@jax.jit
+def closure_query(d, f0, l, extra, depth, direct):
+    """allowed: bool[B] — device-side query (cheap-dispatch deployments).
+
+    d: uint8[m_pad, m_pad] closure; f0: int32[B, F0] interior successor rows
+    (PAD-filled); l: int32[B, L] interior in-neighbor rows (PAD-filled);
+    extra: int32[B] (1 for id targets); depth: int32[B]; direct: bool[B].
+    """
+    sub = d[f0[:, :, None], l[:, None, :]]  # uint8[B, F0, L] gather
+    best = jnp.min(sub, axis=(1, 2)).astype(jnp.int32)
+    # INF must never satisfy any depth budget (valid distances are <= 254,
+    # so 255 is unambiguously "unreachable")
+    best = jnp.where(best >= INF_DIST, jnp.int32(1 << 30), best)
+    total = 1 + best + extra
+    return jnp.logical_or(
+        jnp.logical_and(direct, depth >= 1), total <= depth
+    )

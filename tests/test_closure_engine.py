@@ -1,0 +1,286 @@
+"""ClosureCheckEngine vs the host oracle: the gather-only closure path must
+agree bit-for-bit with host BFS on every graph — the same scenario matrix the
+lockstep device engines run (reference internal/check/engine_test.go:45-581),
+plus closure-specific edges: overflow fallback, interior-limit fallback, and
+write-driven closure rebuilds."""
+
+import numpy as np
+import pytest
+
+from keto_tpu.engine import CheckEngine
+from keto_tpu.engine.closure import ClosureCheckEngine
+from keto_tpu.graph import SnapshotManager
+from keto_tpu.graph.interior import build_interior
+from keto_tpu.relationtuple import RelationTuple, SubjectSet
+from keto_tpu.store import InMemoryTupleStore
+
+from test_device_engines import random_store
+
+
+def t(s: str) -> RelationTuple:
+    return RelationTuple.from_string(s)
+
+
+@pytest.fixture
+def store():
+    return InMemoryTupleStore()
+
+
+def make_engines(store, max_depth=5, **kw):
+    mgr = SnapshotManager(store)
+    return (
+        CheckEngine(store, max_depth=max_depth),
+        ClosureCheckEngine(mgr, max_depth=max_depth, **kw),
+    )
+
+
+class TestClosureScenarios:
+    def test_direct_inclusion(self, store):
+        store.write_relation_tuples(t("n:obj#access@alice"))
+        _, eng = make_engines(store)
+        assert eng.subject_is_allowed(t("n:obj#access@alice"))
+        assert not eng.subject_is_allowed(t("n:obj#access@bob"))
+
+    def test_indirect_inclusion_two_levels(self, store):
+        store.write_relation_tuples(
+            t("n:obj#access@(n:org#member)"),
+            t("n:org#member@(n:team#member)"),
+            t("n:team#member@alice"),
+        )
+        _, eng = make_engines(store)
+        assert eng.subject_is_allowed(t("n:obj#access@alice"))
+        assert eng.subject_is_allowed(t("n:obj#access@(n:team#member)"))
+        assert not eng.subject_is_allowed(t("n:obj#access@mallory"))
+
+    def test_wrong_object_or_relation(self, store):
+        store.write_relation_tuples(t("n:obj#access@alice"))
+        _, eng = make_engines(store)
+        assert not eng.subject_is_allowed(t("n:other#access@alice"))
+        assert not eng.subject_is_allowed(t("n:obj#write@alice"))
+        assert not eng.subject_is_allowed(t("other:obj#access@alice"))
+
+    def test_circular_tuples_terminate(self, store):
+        store.write_relation_tuples(t("n:a#r@(n:b#r)"), t("n:b#r@(n:a#r)"))
+        _, eng = make_engines(store)
+        assert not eng.subject_is_allowed(t("n:a#r@alice"))
+        # the sets themselves are mutually reachable (cycle of length 2)
+        assert eng.subject_is_allowed(t("n:a#r@(n:a#r)"))
+        assert eng.subject_is_allowed(t("n:a#r@(n:b#r)"))
+
+    def test_depth_budget(self, store):
+        store.write_relation_tuples(
+            t("n:obj#r@(n:s1#m)"),
+            t("n:s1#m@(n:s2#m)"),
+            t("n:s2#m@(n:s3#m)"),
+            t("n:s3#m@alice"),
+        )
+        _, eng = make_engines(store, max_depth=10)
+        req = t("n:obj#r@alice")
+        assert not eng.subject_is_allowed(req, max_depth=3)
+        assert eng.subject_is_allowed(req, max_depth=4)
+        assert eng.subject_is_allowed(req, max_depth=0)  # clamps to global
+        assert eng.subject_is_allowed(req, max_depth=99)
+
+    def test_global_max_depth_precedence(self, store):
+        store.write_relation_tuples(
+            t("n:obj#r@(n:s1#m)"),
+            t("n:s1#m@(n:s2#m)"),
+            t("n:s2#m@alice"),
+        )
+        _, eng = make_engines(store, max_depth=2)
+        assert not eng.subject_is_allowed(t("n:obj#r@alice"), max_depth=50)
+
+    def test_subject_set_exact_match_semantics(self, store):
+        store.write_relation_tuples(t("n:obj#r@alice"))
+        _, eng = make_engines(store)
+        assert not eng.subject_is_allowed(t("n:obj#r@(n:obj#r)"))
+
+    def test_set_target_depth_one(self, store):
+        # direct set-to-set edge must be allowed at depth 1 exactly
+        store.write_relation_tuples(t("n:obj#r@(n:grp#m)"), t("n:grp#m@u"))
+        _, eng = make_engines(store)
+        assert eng.subject_is_allowed(t("n:obj#r@(n:grp#m)"), max_depth=1)
+
+    def test_unknown_everything(self, store):
+        _, eng = make_engines(store)
+        assert not eng.subject_is_allowed(t("no:thing#here@nobody"))
+
+    def test_write_visibility_rebuilds_closure(self, store):
+        _, eng = make_engines(store)
+        req = t("n:obj#r@alice")
+        assert not eng.subject_is_allowed(req)
+        store.write_relation_tuples(req)
+        assert eng.subject_is_allowed(req)
+        store.delete_relation_tuples(req)
+        assert not eng.subject_is_allowed(req)
+        # indirect path appears after incremental writes
+        store.write_relation_tuples(t("n:obj#r@(n:g#m)"))
+        store.write_relation_tuples(t("n:g#m@alice"))
+        assert eng.subject_is_allowed(req)
+
+    def test_batch_mixed_depths(self, store):
+        store.write_relation_tuples(
+            t("n:obj#r@(n:s1#m)"),
+            t("n:s1#m@alice"),
+            t("n:obj#r@bob"),
+        )
+        _, eng = make_engines(store)
+        reqs = [t("n:obj#r@alice"), t("n:obj#r@bob"), t("n:obj#r@eve")]
+        assert eng.batch_check(reqs, depths=[1, 1, 5]) == [False, True, False]
+        assert eng.batch_check(reqs, depths=[2, 1, 5]) == [True, True, False]
+
+
+def _random_requests(rng, n_objects, n_users, k=64):
+    reqs = []
+    for _ in range(k):
+        obj = f"o{rng.integers(n_objects)}"
+        rel = f"r{rng.integers(3)}"
+        if rng.random() < 0.3:
+            sub = f"n:o{rng.integers(n_objects)}#r{rng.integers(3)}"
+        else:
+            sub = f"u{rng.integers(n_users)}"
+        reqs.append(t(f"n:{obj}#{rel}@({sub})"))
+    return reqs
+
+
+class TestClosureMatchesOracle:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_graphs(self, seed):
+        rng = np.random.default_rng(seed)
+        store = random_store(rng, n_objects=15, n_users=10, n_edges=120)
+        for depth in (1, 2, 3, 5, 8):
+            host, eng = make_engines(store, max_depth=depth)
+            reqs = _random_requests(rng, 15, 10)
+            expect = [host.subject_is_allowed(r) for r in reqs]
+            got = eng.batch_check(reqs)
+            assert got == expect, f"seed={seed} depth={depth}"
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_graphs_per_request_depths(self, seed):
+        rng = np.random.default_rng(seed + 50)
+        store = random_store(rng, n_objects=12, n_users=8, n_edges=90)
+        host, eng = make_engines(store, max_depth=8)
+        reqs = _random_requests(rng, 12, 8)
+        depths = [int(rng.integers(1, 9)) for _ in reqs]
+        expect = [
+            host.subject_is_allowed(r, max_depth=d)
+            for r, d in zip(reqs, depths)
+        ]
+        assert eng.batch_check(reqs, depths=depths) == expect
+
+    @pytest.mark.parametrize("seed", range(2))
+    def test_overflow_rows_fall_back_exactly(self, seed):
+        """Tiny F0/L widths force the overflow path; answers stay exact."""
+        rng = np.random.default_rng(seed + 200)
+        store = random_store(rng, n_objects=10, n_users=6, n_edges=100)
+        host, eng = make_engines(store, max_depth=5, f0_max=1, l_max=1)
+        reqs = _random_requests(rng, 10, 6)
+        expect = [host.subject_is_allowed(r) for r in reqs]
+        assert eng.batch_check(reqs) == expect
+
+    def test_interior_limit_falls_back_whole_batch(self):
+        rng = np.random.default_rng(7)
+        store = random_store(rng, n_objects=10, n_users=6, n_edges=80)
+        host, eng = make_engines(store, max_depth=5, interior_limit=2)
+        reqs = _random_requests(rng, 10, 6)
+        expect = [host.subject_is_allowed(r) for r in reqs]
+        assert eng.batch_check(reqs) == expect
+
+
+class TestCheckIds:
+    @pytest.mark.parametrize("interior_limit", [16384, 2])
+    def test_array_api_matches_object_api(self, interior_limit):
+        rng = np.random.default_rng(11)
+        store = random_store(rng, n_objects=12, n_users=8, n_edges=100)
+        host, eng = make_engines(
+            store, max_depth=5, interior_limit=interior_limit
+        )
+        reqs = _random_requests(rng, 12, 8)
+        snap = eng.snapshots.snapshot()
+        start = np.array(
+            [
+                snap.node_for_set(r.namespace, r.object, r.relation)
+                for r in reqs
+            ],
+            dtype=np.int64,
+        )
+        target = np.array(
+            [snap.node_for_subject(r.subject) for r in reqs], dtype=np.int64
+        )
+        from keto_tpu.relationtuple import SubjectID
+        is_id = np.array(
+            [isinstance(r.subject, SubjectID) for r in reqs]
+        )
+        expect = [host.subject_is_allowed(r) for r in reqs]
+        got = eng.check_ids(start, target, is_id)
+        assert got.tolist() == expect
+
+    def test_empty_batch(self):
+        rng = np.random.default_rng(13)
+        store = random_store(rng, n_objects=6, n_users=4, n_edges=30)
+        _, eng = make_engines(store, max_depth=5)
+        assert eng.batch_check([]) == []
+        got = eng.check_ids(
+            np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, bool)
+        )
+        assert got.tolist() == []
+
+    def test_huge_max_depth_stays_exact(self):
+        """max_depth beyond the uint8 distance range must not produce
+        spurious allows (the INF sentinel would collide at depth >= 256)."""
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(
+            RelationTuple.from_string("n:a#r@(n:b#r)"),
+            RelationTuple.from_string("n:x#r@(n:y#r)"),
+        )
+        host, eng = make_engines(store, max_depth=256)
+        req = RelationTuple.from_string("n:a#r@(n:y#r)")
+        assert host.subject_is_allowed(req) is False
+        assert eng.subject_is_allowed(req) is False
+        # a depth-255 engine still uses the closure and stays exact
+        host2, eng2 = make_engines(store, max_depth=255)
+        assert eng2.subject_is_allowed(req) is False
+        assert eng2.subject_is_allowed(
+            RelationTuple.from_string("n:a#r@(n:b#r)")
+        )
+
+    def test_unknown_ids_denied(self):
+        rng = np.random.default_rng(12)
+        store = random_store(rng, n_objects=6, n_users=4, n_edges=30)
+        _, eng = make_engines(store, max_depth=5, interior_limit=2)
+        snap = eng.snapshots.snapshot()
+        dummy = snap.dummy_node
+        got = eng.check_ids(
+            np.array([dummy]), np.array([dummy]), np.array([True])
+        )
+        assert got.tolist() == [False]
+
+
+class TestInteriorGraph:
+    def test_decomposition_shape(self, store):
+        store.write_relation_tuples(
+            t("n:doc#view@(n:doc#own)"),   # doc#own interior
+            t("n:doc#own@(n:team#m)"),     # team#m interior
+            t("n:team#m@alice"),           # id sink
+            t("n:lonely#r@bob"),           # lonely#r has no in-edges
+        )
+        snap = SnapshotManager(store).snapshot()
+        ig = build_interior(snap)
+        assert ig.m == 2  # doc#own, team#m
+        interior_nodes = {
+            snap.vocab.key(int(i)) for i in ig.interior_ids
+        }
+        assert interior_nodes == {("n", "doc", "own"), ("n", "team", "m")}
+        # direct edge test
+        s = snap.node_for_set("n", "team", "m")
+        a = snap.vocab.lookup(("alice",))
+        assert ig.direct_edge(
+            np.array([s], dtype=np.int64), np.array([a], dtype=np.int64)
+        ).tolist() == [True]
+
+    def test_wildcard_subject_is_plain_id(self, store):
+        # the cat-videos '*' convention: a literal id, nothing special
+        store.write_relation_tuples(t("v:/cats/1#view@*"))
+        _, eng = make_engines(store)
+        assert eng.subject_is_allowed(t("v:/cats/1#view@*"))
+        assert not eng.subject_is_allowed(t("v:/cats/2#view@*"))
