@@ -1,17 +1,25 @@
-"""Benchmark: batched Check throughput on the device engine.
+"""Benchmark ladder: batched Check/Expand throughput on the closure engine.
 
-Builds a synthetic RBAC-shaped tuple graph (users -> groups -> roles ->
-resource grants, BASELINE.json's "rbac" config family), then measures
-steady-state batched check RPS through DeviceCheckEngine on whatever
-device JAX gives (real TPU chip under the driver).
+Runs the BASELINE.json config ladder (as far as one chip + host RAM allow):
 
-Prints ONE json line:
+- ``rbac1m``   — synthetic RBAC, 1M tuples (users->groups->roles->grants).
+- ``github10m``— GitHub-style, 10M tuples: users/teams/orgs/repos, team
+  nesting, per-repo permission grants; mixed Check + Expand traffic.
+- ``rbac100m`` — 100M-tuple RBAC (BASELINE north-star scale); opt-in via
+  BENCH_SCALE=100m (build takes minutes).
+
+Each config reports object-path RPS (full RelationTuple encode, what a
+transport handler pays), array-path RPS (check_ids, what array-native /
+sharded tiers pay), p50/p95 batch latency, expand p95, and build times.
+
+Prints ONE json line (the largest completed config's object-path RPS):
   {"metric": "check_rps", "value": N, "unit": "checks/s", "vs_baseline": x}
 vs_baseline is relative to the BASELINE.json north star of 1,000,000
 check RPCs/sec (the reference publishes no measured numbers — SURVEY.md §6).
 
-Env knobs: BENCH_TUPLES (default 1_000_000), BENCH_BATCH (default 4096),
-BENCH_ITERS (default 20), BENCH_MODE (auto|dense|scatter).
+Env knobs: BENCH_CONFIGS (csv; default "rbac1m,github10m"), BENCH_SCALE
+(=100m appends rbac100m), BENCH_BATCH (default 4096), BENCH_ITERS (default
+30), BENCH_ENGINE (closure|device, default closure).
 """
 
 from __future__ import annotations
@@ -24,72 +32,125 @@ import time
 import numpy as np
 
 
-def build_rbac_graph(n_tuples: int, rng: np.random.Generator):
-    """users ∈ groups ∈ roles -> per-resource grants, with ~15% subject-set
-    indirection depth beyond 2 (role hierarchies)."""
-    from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
-    from keto_tpu.store import InMemoryTupleStore
+# ---------------------------------------------------------------------------
+# graph generators (columnar bulk: node-key pools, no tuple objects)
+# ---------------------------------------------------------------------------
+
+
+def gen_rbac(n_tuples: int, rng: np.random.Generator):
+    """users ∈ groups ∈ roles -> per-resource grants (BASELINE 'rbac')."""
+    from keto_tpu.store import ColumnarTupleStore
 
     n_users = max(n_tuples // 10, 100)
     n_groups = max(n_tuples // 100, 20)
     n_roles = max(n_groups // 10, 5)
     n_resources = max(n_tuples // 3, 50)
 
-    tuples: list[RelationTuple] = []
-    # users -> groups  (~40%)
-    for _ in range(int(n_tuples * 0.4)):
-        tuples.append(
-            RelationTuple(
-                "rbac", f"g{rng.integers(n_groups)}", "member",
-                SubjectID(f"u{rng.integers(n_users)}"),
-            )
-        )
+    users = [(f"u{i}",) for i in range(n_users)]
+    groups = [("rbac", f"g{i}", "member") for i in range(n_groups)]
+    roles = [("rbac", f"role{i}", "member") for i in range(n_roles)]
+    resources = [("rbac", f"res{i}", "view") for i in range(n_resources)]
+
+    src, dst = [], []
+    # users -> groups (~40%)
+    k = int(n_tuples * 0.4)
+    src += [groups[i] for i in rng.integers(n_groups, size=k)]
+    dst += [users[i] for i in rng.integers(n_users, size=k)]
     # groups -> roles (~10%)
-    for _ in range(int(n_tuples * 0.1)):
-        tuples.append(
-            RelationTuple(
-                "rbac", f"role{rng.integers(n_roles)}", "member",
-                SubjectSet("rbac", f"g{rng.integers(n_groups)}", "member"),
-            )
-        )
+    k = int(n_tuples * 0.1)
+    src += [roles[i] for i in rng.integers(n_roles, size=k)]
+    dst += [groups[i] for i in rng.integers(n_groups, size=k)]
     # role hierarchy (~5%)
-    for _ in range(int(n_tuples * 0.05)):
-        a, b = rng.integers(n_roles, size=2)
-        tuples.append(
-            RelationTuple(
-                "rbac", f"role{a}", "member",
-                SubjectSet("rbac", f"role{b}", "member"),
-            )
-        )
+    k = int(n_tuples * 0.05)
+    src += [roles[i] for i in rng.integers(n_roles, size=k)]
+    dst += [roles[i] for i in rng.integers(n_roles, size=k)]
     # resource grants -> roles or groups (~45%)
-    while len(tuples) < n_tuples:
-        r = rng.integers(n_resources)
-        if rng.random() < 0.5:
-            sub = SubjectSet("rbac", f"role{rng.integers(n_roles)}", "member")
-        else:
-            sub = SubjectSet("rbac", f"g{rng.integers(n_groups)}", "member")
-        tuples.append(RelationTuple("rbac", f"res{r}", "view", sub))
+    k = n_tuples - len(src)
+    src += [resources[i] for i in rng.integers(n_resources, size=k)]
+    half = rng.random(k) < 0.5
+    role_pick = rng.integers(n_roles, size=k)
+    group_pick = rng.integers(n_groups, size=k)
+    dst += [
+        roles[role_pick[i]] if half[i] else groups[group_pick[i]]
+        for i in range(k)
+    ]
 
-    store = InMemoryTupleStore()
-    store.write_relation_tuples(*tuples)
-    return store, n_users, n_resources
+    store = ColumnarTupleStore()
+    store.bulk_load_edges(src, dst)
+
+    def sample(rng, k):
+        s = [resources[i] for i in rng.integers(n_resources, size=k)]
+        d = [users[i] for i in rng.integers(n_users, size=k)]
+        return s, d
+
+    expand_roots = [resources[i] for i in rng.integers(n_resources, size=256)]
+    return store, sample, expand_roots
 
 
-def main():
-    n_tuples = int(os.environ.get("BENCH_TUPLES", 1_000_000))
-    batch = int(os.environ.get("BENCH_BATCH", 4096))
-    iters = int(os.environ.get("BENCH_ITERS", 20))
-    mode = os.environ.get("BENCH_MODE", "auto")
+def gen_github(n_tuples: int, rng: np.random.Generator):
+    """GitHub-style: team membership + nesting, per-repo permission grants
+    to teams or direct collaborators (BASELINE 'github' mixed config)."""
+    from keto_tpu.store import ColumnarTupleStore
 
-    import jax
+    n_users = max(n_tuples // 8, 100)
+    n_teams = max(n_tuples // 400, 20)  # realistically few teams
+    n_repos = max(n_tuples // 3, 50)
+    perms = ("pull", "triage", "push", "admin")
 
-    from keto_tpu.engine.device import DeviceCheckEngine
+    users = [(f"u{i}",) for i in range(n_users)]
+    teams = [("gh", f"team{i}", "member") for i in range(n_teams)]
+    repo_perm = [
+        ("gh", f"repo{i}", p) for i in range(n_repos) for p in perms
+    ]
+
+    src, dst = [], []
+    # team membership (~45%)
+    k = int(n_tuples * 0.45)
+    src += [teams[i] for i in rng.integers(n_teams, size=k)]
+    dst += [users[i] for i in rng.integers(n_users, size=k)]
+    # team nesting (~3%)
+    k = int(n_tuples * 0.03)
+    src += [teams[i] for i in rng.integers(n_teams, size=k)]
+    dst += [teams[i] for i in rng.integers(n_teams, size=k)]
+    # repo permission grants (~52%): 80% to teams, 20% direct collaborators
+    k = n_tuples - len(src)
+    src += [repo_perm[i] for i in rng.integers(len(repo_perm), size=k)]
+    to_team = rng.random(k) < 0.8
+    team_pick = rng.integers(n_teams, size=k)
+    user_pick = rng.integers(n_users, size=k)
+    dst += [
+        teams[team_pick[i]] if to_team[i] else users[user_pick[i]]
+        for i in range(k)
+    ]
+
+    store = ColumnarTupleStore()
+    store.bulk_load_edges(src, dst)
+
+    pull_perms = [("gh", f"repo{i}", "pull") for i in range(n_repos)]
+
+    def sample(rng, k):
+        s = [pull_perms[i] for i in rng.integers(n_repos, size=k)]
+        d = [users[i] for i in rng.integers(n_users, size=k)]
+        return s, d
+
+    expand_roots = [pull_perms[i] for i in rng.integers(n_repos, size=256)]
+    return store, sample, expand_roots
+
+
+# ---------------------------------------------------------------------------
+# measurement
+# ---------------------------------------------------------------------------
+
+
+def run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_kind: str):
+    from keto_tpu.engine.device import DeviceCheckEngine, SnapshotExpandEngine
+    from keto_tpu.engine.closure import ClosureCheckEngine
     from keto_tpu.graph import SnapshotManager
-    from keto_tpu.relationtuple import RelationTuple, SubjectID
+    from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
 
     rng = np.random.default_rng(7)
     t0 = time.time()
-    store, n_users, n_resources = build_rbac_graph(n_tuples, rng)
+    store, sample, expand_roots = gen(n_tuples, rng)
     t_build = time.time() - t0
 
     t0 = time.time()
@@ -97,57 +158,150 @@ def main():
     snap = snapshots.snapshot()
     t_encode = time.time() - t0
 
-    engine = DeviceCheckEngine(snapshots, max_depth=5, mode=mode)
+    if engine_kind == "device":
+        engine = DeviceCheckEngine(snapshots, max_depth=5)
+    else:
+        engine = ClosureCheckEngine(
+            snapshots, max_depth=5, interior_limit=32768
+        )
 
-    # request mix: resource-view checks for random users (the Zanzibar hot
-    # query), ~70% expected denials like production check traffic
-    def make_requests(k):
+    def to_requests(skeys, dkeys):
         return [
             RelationTuple(
-                "rbac", f"res{rng.integers(n_resources)}", "view",
-                SubjectID(f"u{rng.integers(n_users)}"),
+                namespace=s[0],
+                object=s[1],
+                relation=s[2],
+                subject=SubjectID(d[0])
+                if len(d) == 1
+                else SubjectSet(namespace=d[0], object=d[1], relation=d[2]),
             )
-            for _ in range(k)
+            for s, d in zip(skeys, dkeys)
         ]
 
-    warm = make_requests(batch)
+    warm = to_requests(*sample(rng, batch))
     t0 = time.time()
-    engine.batch_check(warm)  # compile
-    t_compile = time.time() - t0
-    engine.batch_check(warm)  # steady-state warm
+    engine.batch_check(warm)  # closure build + compile
+    t_first = time.time() - t0
+    engine.batch_check(warm)
 
-    batches = [make_requests(batch) for _ in range(iters)]
-    t0 = time.time()
+    # object path: full RelationTuple encode per request
+    lat = []
     n_allowed = 0
+    batches = [to_requests(*sample(rng, batch)) for _ in range(iters)]
+    t_all = time.time()
     for reqs in batches:
-        res = engine.batch_check(reqs)
-        n_allowed += sum(res)
-    elapsed = time.time() - t0
-    rps = batch * iters / elapsed
+        t0 = time.time()
+        n_allowed += sum(engine.batch_check(reqs))
+        lat.append(time.time() - t0)
+    obj_elapsed = time.time() - t_all
+    obj_rps = batch * iters / obj_elapsed
+
+    # array path: pre-encoded ids (array-native clients / sharded tier)
+    enc_rps = None
+    if hasattr(engine, "check_ids"):
+        lookup = snap.vocab.lookup
+        dummy = snap.dummy_node
+        enc_batches = []
+        for _ in range(iters):
+            skeys, dkeys = sample(rng, batch)
+            s_ids = np.array(
+                [v if (v := lookup(k)) is not None else dummy for k in skeys],
+                np.int64,
+            )
+            d_ids = np.array(
+                [v if (v := lookup(k)) is not None else dummy for k in dkeys],
+                np.int64,
+            )
+            is_id = np.fromiter(
+                (len(k) == 1 for k in dkeys), bool, count=batch
+            )
+            enc_batches.append((s_ids, d_ids, is_id))
+        engine.check_ids(*enc_batches[0])
+        t0 = time.time()
+        for s_ids, d_ids, is_id in enc_batches:
+            engine.check_ids(s_ids, d_ids, is_id)
+        enc_rps = batch * iters / (time.time() - t0)
+
+    # expand: host tree walk over the resident CSR
+    expander = SnapshotExpandEngine(snapshots, max_depth=5)
+    exp_lat = []
+    for key in expand_roots:
+        subject = SubjectSet(namespace=key[0], object=key[1], relation=key[2])
+        t0 = time.time()
+        expander.build_tree(subject, max_depth=3)
+        exp_lat.append(time.time() - t0)
 
     meta = {
+        "config": name,
         "tuples": n_tuples,
         "nodes": snap.num_nodes,
-        "padded_nodes": snap.padded_nodes,
         "padded_edges": snap.padded_edges,
         "batch": batch,
         "iters": iters,
-        "device": str(jax.devices()[0]),
-        "mode": "dense" if engine._device_graph(snap).dense else "scatter",
+        "engine": engine_kind,
         "build_s": round(t_build, 2),
         "encode_s": round(t_encode, 2),
-        "compile_s": round(t_compile, 2),
+        "first_batch_s": round(t_first, 2),
+        "check_rps": round(obj_rps),
+        "check_rps_encoded": round(enc_rps) if enc_rps else None,
+        "batch_p50_ms": round(1000 * float(np.percentile(lat, 50)), 2),
+        "batch_p95_ms": round(1000 * float(np.percentile(lat, 95)), 2),
+        "expand_p50_ms": round(1000 * float(np.percentile(exp_lat, 50)), 3),
+        "expand_p95_ms": round(1000 * float(np.percentile(exp_lat, 95)), 3),
         "allowed_frac": round(n_allowed / (batch * iters), 3),
-        "batch_latency_ms": round(1000 * elapsed / iters, 2),
     }
-    print(json.dumps(meta), file=sys.stderr)
+    if hasattr(engine, "_cached") and engine._cached is not None:
+        meta["interior_nodes"] = int(engine._cached.ig.m)
+    print(json.dumps(meta), file=sys.stderr, flush=True)
+    return meta
+
+
+CONFIGS = {
+    "rbac1m": (1_000_000, gen_rbac),
+    "github10m": (10_000_000, gen_github),
+    "rbac100m": (100_000_000, gen_rbac),
+}
+
+
+def main():
+    import jax
+
+    batch = int(os.environ.get("BENCH_BATCH", 4096))
+    iters = int(os.environ.get("BENCH_ITERS", 30))
+    engine_kind = os.environ.get("BENCH_ENGINE", "closure")
+    names = os.environ.get("BENCH_CONFIGS", "rbac1m,github10m").split(",")
+    if os.environ.get("BENCH_SCALE") == "100m" and "rbac100m" not in names:
+        names.append("rbac100m")
+
+    print(
+        json.dumps({"device": str(jax.devices()[0])}),
+        file=sys.stderr,
+        flush=True,
+    )
+    results = []
+    for name in names:
+        name = name.strip()
+        if name not in CONFIGS:
+            print(
+                f"unknown BENCH_CONFIGS entry {name!r}; known: "
+                f"{sorted(CONFIGS)}",
+                file=sys.stderr,
+            )
+            continue
+        n, gen = CONFIGS[name]
+        results.append(run_config(name, n, gen, batch, iters, engine_kind))
+
+    if not results:
+        print("no valid bench configs ran", file=sys.stderr)
+        sys.exit(1)
+    primary = results[-1]  # largest completed config
     print(
         json.dumps(
             {
                 "metric": "check_rps",
-                "value": round(rps),
+                "value": primary["check_rps"],
                 "unit": "checks/s",
-                "vs_baseline": round(rps / 1_000_000, 4),
+                "vs_baseline": round(primary["check_rps"] / 1_000_000, 4),
             }
         )
     )

@@ -282,10 +282,12 @@ class ClosureCheckEngine:
                     reqs, depths=[int(d) for d in depth]
                 )
             )
-            # rows with unknown (dummy-mapped) endpoints are always denied;
-            # the decoded placeholder must not accidentally match
-            n_live = len(snap.vocab)
-            res[(start >= n_live) | (target >= n_live)] = False
+            # rows with unknown endpoints are always denied. Bound by the
+            # SNAPSHOT's node count, not the live vocab: concurrent writes
+            # can grow the vocab past padded_nodes, making the dummy id
+            # decodable into whatever key now owns it
+            n_snap = min(snap.num_nodes, snap.dummy_node)
+            res[(start >= n_snap) | (target >= n_snap)] = False
             return res
         return self._check_arrays(snap, art, start, target, is_id, depth)
 

@@ -183,10 +183,7 @@ class SnapshotManager:
         self.min_nodes = min_nodes
         self.min_edges = min_edges
         self._dirty = False
-        tuples, version = store.snapshot()
-        self._snap = SnapshotBuilder(
-            min_nodes=min_nodes, min_edges=min_edges
-        ).build(tuples, version)
+        self._snap = self._encode()
         subscribe = getattr(store, "subscribe_deltas", None)
         self._delta_cb = None
         if subscribe is not None:
@@ -224,13 +221,26 @@ class SnapshotManager:
             return self._snap
 
     def _rebuild(self) -> None:
+        self._snap = self._encode()
+        self._dirty = False
+
+    def _encode(self) -> GraphSnapshot:
+        snapshot_ids = getattr(self._store, "snapshot_ids", None)
+        if snapshot_ids is not None:
+            # columnar store: pre-encoded edges against the store's own
+            # append-only vocab — zero tuple objects materialized
+            src, dst, vocab, version = snapshot_ids()
+            return SnapshotBuilder(
+                vocab=vocab,
+                min_nodes=self.min_nodes,
+                min_edges=self.min_edges,
+            ).build_from_ids(src, dst, version)
         tuples, version = self._store.snapshot()
         # Fresh vocab on rebuild: deletes may have orphaned nodes, and a fresh
         # intern keeps ids dense. Stable-id incremental path never comes here.
-        self._snap = SnapshotBuilder(
+        return SnapshotBuilder(
             min_nodes=self.min_nodes, min_edges=self.min_edges
         ).build(tuples, version)
-        self._dirty = False
 
     # -- write side (delta feed) ---------------------------------------------
 
@@ -242,6 +252,11 @@ class SnapshotManager:
     ) -> None:
         with self._lock:
             snap = self._snap
+            if inserted is None or deleted is None:
+                # bulk change of unknown shape (columnar bulk load):
+                # rebuild on next read
+                self._dirty = True
+                return
             if self._dirty or version != snap.version + 1 or deleted:
                 self._dirty = True
                 return

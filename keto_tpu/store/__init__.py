@@ -1,3 +1,4 @@
 from .memory import InMemoryTupleStore
+from .columnar import ColumnarTupleStore
 
-__all__ = ["InMemoryTupleStore"]
+__all__ = ["InMemoryTupleStore", "ColumnarTupleStore"]

@@ -1,0 +1,451 @@
+"""Columnar relation-tuple store: the TPU-native persister for big graphs.
+
+The in-memory store (store/memory.py) holds Python RelationTuple objects —
+fine for serving-sized working sets, prohibitive at the 10M–1B tuple scale
+the BASELINE ladder targets (object overhead alone is ~50x the data). This
+store keeps tuples as interned int32 numpy columns:
+
+    ns | obj | rel | sub_is_set | sub_ns | sub_obj | sub_rel | sub_id
+
+plus the graph-node encoding the snapshot layer needs (``src_node`` /
+``dst_node`` against a shared NodeVocab, maintained at write time). That
+makes ``snapshot_ids()`` a zero-copy column slice: SnapshotManager feeds the
+device encoder without ever materializing tuple objects — the reference's
+"SQL table" (internal/persistence/sql/relationtuples.go:18-33 row struct)
+re-thought as arrays whose natural consumer is an accelerator, not a cursor.
+
+Contract parity: implements the same Manager surface as the in-memory and
+sqlite stores (write/get/delete/delete-all/transact, opaque page tokens,
+namespace validation, insertion order). Deletes tombstone a row; tombstones
+are compacted lazily. Duplicate writes are idempotent. The NodeVocab is
+append-only (deleted nodes keep their ids — snapshots handle orphans).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..graph.vocab import NodeVocab, set_key, subject_node_key
+from ..namespace.definitions import NamespaceManager
+from ..relationtuple.definitions import (
+    Manager,
+    RelationQuery,
+    RelationTuple,
+    Subject,
+    SubjectID,
+    SubjectSet,
+)
+from ..utils.errors import ErrInvalidTuple
+from ..utils.pagination import (
+    PaginationOptions,
+    decode_page_token,
+    encode_page_token,
+)
+
+_GROW = 1.5  # column growth factor
+
+
+class _StringPool:
+    """Append-only str <-> int32 interning."""
+
+    def __init__(self) -> None:
+        self._id_of: dict[str, int] = {}
+        self._strings: list[str] = []
+
+    def intern(self, s: str) -> int:
+        i = self._id_of.get(s)
+        if i is None:
+            i = len(self._strings)
+            self._id_of[s] = i
+            self._strings.append(s)
+        return i
+
+    def lookup(self, s: str) -> Optional[int]:
+        return self._id_of.get(s)
+
+    def value(self, i: int) -> str:
+        return self._strings[i]
+
+
+class ColumnarTupleStore(Manager):
+    def __init__(
+        self,
+        namespace_manager: NamespaceManager | None = None,
+        network_id: str | None = None,
+    ):
+        self._lock = threading.RLock()
+        self.namespace_manager = namespace_manager
+        self.network_id = network_id or str(uuid.uuid4())
+        self.vocab = NodeVocab()  # shared with the snapshot layer
+        self._ns = _StringPool()
+        self._obj = _StringPool()
+        self._rel = _StringPool()
+        self._sid = _StringPool()
+        self._n = 0  # rows in use (including tombstones)
+        self._live = 0  # rows alive
+        cap = 1024
+        self._cols = {
+            "ns": np.empty(cap, np.int32),
+            "obj": np.empty(cap, np.int32),
+            "rel": np.empty(cap, np.int32),
+            "sub_is_set": np.empty(cap, bool),
+            "sub_ns": np.empty(cap, np.int32),
+            "sub_obj": np.empty(cap, np.int32),
+            "sub_rel": np.empty(cap, np.int32),
+            "sub_id": np.empty(cap, np.int32),
+            "src_node": np.empty(cap, np.int32),
+            "dst_node": np.empty(cap, np.int32),
+            "alive": np.empty(cap, bool),
+        }
+        # row lookup for dedup/delete: (src_node << 32 | dst_node) -> row
+        # index (packed int keys so bulk paths can use C-speed map())
+        self._row_of: dict[int, int] = {}
+        self._version = 0
+        self._listeners: list[Callable[[int], None]] = []
+        self._delta_listeners: list[
+            Callable[[int, list[RelationTuple], list[RelationTuple]], None]
+        ] = []
+
+    # -- version / change feed ------------------------------------------------
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def subscribe(self, fn: Callable[[int], None]) -> None:
+        self._listeners.append(fn)
+
+    def subscribe_deltas(self, fn) -> None:
+        self._delta_listeners.append(fn)
+
+    def unsubscribe_deltas(self, fn) -> None:
+        try:
+            self._delta_listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def _notify(self, version, inserted=None, deleted=None) -> None:
+        for fn in self._listeners:
+            fn(version)
+        for fn in self._delta_listeners:
+            fn(version, inserted or [], deleted or [])
+
+    # -- internals ------------------------------------------------------------
+
+    def _ensure_capacity(self, extra: int) -> None:
+        need = self._n + extra
+        cap = len(self._cols["ns"])
+        if need <= cap:
+            return
+        new_cap = max(need, int(cap * _GROW))
+        for k, a in self._cols.items():
+            grown = np.empty(new_cap, a.dtype)
+            grown[: self._n] = a[: self._n]
+            self._cols[k] = grown
+
+    def _validate(self, t: RelationTuple) -> None:
+        if t.subject is None:
+            raise ErrInvalidTuple("subject must not be nil")
+        if self.namespace_manager is not None:
+            self.namespace_manager.get_namespace_by_name(t.namespace)
+
+    def _encode_row(self, t: RelationTuple, row: int) -> tuple[int, int]:
+        c = self._cols
+        c["ns"][row] = self._ns.intern(t.namespace)
+        c["obj"][row] = self._obj.intern(t.object)
+        c["rel"][row] = self._rel.intern(t.relation)
+        s = t.subject
+        src = self.vocab.intern(set_key(t.namespace, t.object, t.relation))
+        dst = self.vocab.intern(subject_node_key(s))
+        c["src_node"][row] = src
+        c["dst_node"][row] = dst
+        if isinstance(s, SubjectSet):
+            c["sub_is_set"][row] = True
+            c["sub_ns"][row] = self._ns.intern(s.namespace)
+            c["sub_obj"][row] = self._obj.intern(s.object)
+            c["sub_rel"][row] = self._rel.intern(s.relation)
+            c["sub_id"][row] = -1
+        else:
+            c["sub_is_set"][row] = False
+            c["sub_ns"][row] = -1
+            c["sub_obj"][row] = -1
+            c["sub_rel"][row] = -1
+            c["sub_id"][row] = self._sid.intern(s.id)
+        c["alive"][row] = True
+        return src, dst
+
+    def _decode_row(self, row: int) -> RelationTuple:
+        c = self._cols
+        if c["sub_is_set"][row]:
+            subject: Subject = SubjectSet(
+                namespace=self._ns.value(int(c["sub_ns"][row])),
+                object=self._obj.value(int(c["sub_obj"][row])),
+                relation=self._rel.value(int(c["sub_rel"][row])),
+            )
+        else:
+            subject = SubjectID(id=self._sid.value(int(c["sub_id"][row])))
+        return RelationTuple(
+            namespace=self._ns.value(int(c["ns"][row])),
+            object=self._obj.value(int(c["obj"][row])),
+            relation=self._rel.value(int(c["rel"][row])),
+            subject=subject,
+        )
+
+    def _insert_locked(self, t: RelationTuple) -> Optional[RelationTuple]:
+        """Insert one tuple; returns it when fresh, None when duplicate."""
+        self._ensure_capacity(1)
+        row = self._n
+        src, dst = self._encode_row(t, row)
+        key = (src << 32) | dst
+        existing = self._row_of.get(key)
+        if existing is not None and self._cols["alive"][existing]:
+            return None  # idempotent duplicate
+        self._row_of[key] = row
+        self._n += 1
+        self._live += 1
+        return t
+
+    def _delete_locked(self, t: RelationTuple) -> Optional[RelationTuple]:
+        src = self.vocab.lookup(set_key(t.namespace, t.object, t.relation))
+        dst = self.vocab.lookup(subject_node_key(t.subject))
+        if src is None or dst is None:
+            return None
+        key = (src << 32) | dst
+        row = self._row_of.get(key)
+        if row is None or not self._cols["alive"][row]:
+            return None
+        self._cols["alive"][row] = False
+        self._live -= 1
+        del self._row_of[key]
+        return t
+
+    def _query_mask(self, query: RelationQuery) -> np.ndarray:
+        """bool[n] over rows [0, n): alive and matching the partial filter."""
+        c = self._cols
+        n = self._n
+        mask = c["alive"][:n].copy()
+        if query.namespace is not None:
+            i = self._ns.lookup(query.namespace)
+            mask &= (
+                c["ns"][:n] == i if i is not None else np.zeros(n, bool)
+            )
+        if query.object is not None:
+            i = self._obj.lookup(query.object)
+            mask &= (
+                c["obj"][:n] == i if i is not None else np.zeros(n, bool)
+            )
+        if query.relation is not None:
+            i = self._rel.lookup(query.relation)
+            mask &= (
+                c["rel"][:n] == i if i is not None else np.zeros(n, bool)
+            )
+        if query.subject is not None:
+            dst = self.vocab.lookup(subject_node_key(query.subject))
+            mask &= (
+                c["dst_node"][:n] == dst
+                if dst is not None
+                else np.zeros(n, bool)
+            )
+        return mask
+
+    # -- Manager contract -----------------------------------------------------
+
+    def get_relation_tuples(
+        self, query: RelationQuery, pagination: PaginationOptions | None = None
+    ) -> tuple[list[RelationTuple], str]:
+        pagination = pagination or PaginationOptions()
+        offset = decode_page_token(pagination.token)
+        per_page = pagination.per_page
+        if (
+            self.namespace_manager is not None
+            and query.namespace is not None
+        ):
+            self.namespace_manager.get_namespace_by_name(query.namespace)
+        with self._lock:
+            rows = np.nonzero(self._query_mask(query))[0]
+            page_rows = rows[offset : offset + per_page]
+            page = [self._decode_row(int(r)) for r in page_rows]
+            total = len(rows)
+        next_token = (
+            encode_page_token(offset + per_page)
+            if offset + per_page < total
+            else ""
+        )
+        return page, next_token
+
+    def write_relation_tuples(self, *tuples: RelationTuple) -> None:
+        for t in tuples:
+            self._validate(t)
+        with self._lock:
+            fresh = [
+                f for t in tuples if (f := self._insert_locked(t)) is not None
+            ]
+            self._version += 1
+            v = self._version
+        self._notify(v, inserted=fresh)
+
+    def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
+        with self._lock:
+            gone = [
+                g for t in tuples if (g := self._delete_locked(t)) is not None
+            ]
+            self._version += 1
+            v = self._version
+        self._notify(v, deleted=gone)
+
+    def delete_all_relation_tuples(self, query: RelationQuery) -> None:
+        with self._lock:
+            rows = np.nonzero(self._query_mask(query))[0]
+            gone = [self._decode_row(int(r)) for r in rows]
+            self._cols["alive"][rows] = False
+            self._live -= len(rows)
+            c = self._cols
+            for r in rows:
+                key = (int(c["src_node"][r]) << 32) | int(c["dst_node"][r])
+                self._row_of.pop(key, None)
+            self._version += 1
+            v = self._version
+        self._notify(v, deleted=gone)
+
+    def transact_relation_tuples(
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+    ) -> None:
+        for t in insert:
+            self._validate(t)
+        with self._lock:
+            fresh = [
+                f for t in insert if (f := self._insert_locked(t)) is not None
+            ]
+            gone = [
+                g for t in delete if (g := self._delete_locked(t)) is not None
+            ]
+            self._version += 1
+            v = self._version
+        self._notify(v, inserted=fresh, deleted=gone)
+
+    # -- bulk + snapshot support ----------------------------------------------
+
+    def bulk_load_edges(
+        self,
+        src_keys: Sequence,
+        dst_keys: Sequence,
+    ) -> None:
+        """Bulk ingest pre-built node keys (benchmark/import path): src_keys
+        are (ns, obj, rel) triples, dst_keys are (id,) or (ns, obj, rel).
+        Skips per-tuple namespace validation (input is trusted, e.g. a
+        generator or a dump) but keeps write idempotence: duplicates within
+        the input and against existing rows are dropped."""
+        n_in = len(src_keys)
+        if n_in == 0:
+            return
+        with self._lock:  # interning must not race the per-tuple write path
+            src_all = self.vocab.intern_bulk(src_keys)
+            dst_all = self.vocab.intern_bulk(dst_keys)
+            # dedup within the input (keep first occurrence, insertion
+            # order) and against already-present rows
+            keys_all = (src_all.astype(np.int64) << 32) | dst_all.astype(
+                np.int64
+            )
+            _, first = np.unique(keys_all, return_index=True)
+            first.sort()
+            existing = np.fromiter(
+                map(self._row_of.__contains__, keys_all[first].tolist()),
+                dtype=bool,
+                count=len(first),
+            )
+            take = first[~existing]
+            n_new = len(take)
+            if n_new:
+                src_ids = src_all[take]
+                dst_ids = dst_all[take]
+                src_sel = [src_keys[i] for i in take]
+                dst_sel = [dst_keys[i] for i in take]
+                ns_ids = np.fromiter(
+                    (self._ns.intern(k[0]) for k in src_sel),
+                    np.int32,
+                    count=n_new,
+                )
+                obj_ids = np.fromiter(
+                    (self._obj.intern(k[1]) for k in src_sel),
+                    np.int32,
+                    count=n_new,
+                )
+                rel_ids = np.fromiter(
+                    (self._rel.intern(k[2]) for k in src_sel),
+                    np.int32,
+                    count=n_new,
+                )
+                is_set = np.fromiter(
+                    (len(k) == 3 for k in dst_sel), bool, count=n_new
+                )
+                sub_ns = np.full(n_new, -1, np.int32)
+                sub_obj = np.full(n_new, -1, np.int32)
+                sub_rel = np.full(n_new, -1, np.int32)
+                sub_id = np.full(n_new, -1, np.int32)
+                for i, k in enumerate(dst_sel):
+                    if len(k) == 3:
+                        sub_ns[i] = self._ns.intern(k[0])
+                        sub_obj[i] = self._obj.intern(k[1])
+                        sub_rel[i] = self._rel.intern(k[2])
+                    else:
+                        sub_id[i] = self._sid.intern(k[0])
+                self._ensure_capacity(n_new)
+                n0 = self._n
+                sl = slice(n0, n0 + n_new)
+                c = self._cols
+                c["ns"][sl] = ns_ids
+                c["obj"][sl] = obj_ids
+                c["rel"][sl] = rel_ids
+                c["sub_is_set"][sl] = is_set
+                c["sub_ns"][sl] = sub_ns
+                c["sub_obj"][sl] = sub_obj
+                c["sub_rel"][sl] = sub_rel
+                c["sub_id"][sl] = sub_id
+                c["src_node"][sl] = src_ids
+                c["dst_node"][sl] = dst_ids
+                c["alive"][sl] = True
+                row_of = self._row_of
+                key_list = keys_all[take].tolist()
+                for i, key in enumerate(key_list):
+                    row_of[key] = n0 + i
+                self._n += n_new
+                self._live += n_new
+            self._version += 1
+            v = self._version
+        # bulk: no per-tuple delta; None signals "unknown change, rebuild"
+        for fn in self._listeners:
+            fn(v)
+        for fn in self._delta_listeners:
+            fn(v, None, None)
+
+    def snapshot_ids(
+        self,
+    ) -> tuple[np.ndarray, np.ndarray, NodeVocab, int]:
+        """(src_node, dst_node, vocab, version) — the zero-object fast path
+        for SnapshotManager/SnapshotBuilder.build_from_ids."""
+        with self._lock:
+            n = self._n
+            alive = self._cols["alive"][:n]
+            src = self._cols["src_node"][:n][alive].copy()
+            dst = self._cols["dst_node"][:n][alive].copy()
+            return src, dst, self.vocab, self._version
+
+    def all_tuples(self) -> list[RelationTuple]:
+        with self._lock:
+            rows = np.nonzero(self._cols["alive"][: self._n])[0]
+            return [self._decode_row(int(r)) for r in rows]
+
+    def snapshot(self) -> tuple[list[RelationTuple], int]:
+        with self._lock:
+            return self.all_tuples(), self._version
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._live

@@ -31,12 +31,17 @@ def nsmgr():
     return MemoryNamespaceManager()
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "columnar"])
 def store(request, nsmgr, tmp_path):
-    """Every contract/engine test runs against both persistence backends —
+    """Every contract/engine test runs against all persistence backends —
     the reference's one-suite-many-DSNs matrix (SURVEY.md §4)."""
     if request.param == "memory":
         yield InMemoryTupleStore(namespace_manager=nsmgr)
+        return
+    if request.param == "columnar":
+        from keto_tpu.store import ColumnarTupleStore
+
+        yield ColumnarTupleStore(namespace_manager=nsmgr)
         return
     from keto_tpu.persistence import SQLiteTupleStore
 

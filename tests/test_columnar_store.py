@@ -1,0 +1,135 @@
+"""Columnar-store specifics: bulk ingest, snapshot_ids fast path, engines
+over pre-encoded columns. (The full Manager contract suite in test_store.py
+already runs against this backend via the parametrized `store` fixture.)"""
+
+import numpy as np
+import pytest
+
+from keto_tpu.engine import CheckEngine
+from keto_tpu.engine.closure import ClosureCheckEngine
+from keto_tpu.graph import SnapshotManager
+from keto_tpu.relationtuple import RelationQuery, RelationTuple, SubjectID
+from keto_tpu.store import ColumnarTupleStore
+
+
+def t(s):
+    return RelationTuple.from_string(s)
+
+
+class TestBulkLoad:
+    def test_bulk_then_queries(self):
+        s = ColumnarTupleStore()
+        src = [("n", f"o{i}", "r") for i in range(100)]
+        dst = [(f"u{i % 7}",) for i in range(100)]
+        s.bulk_load_edges(src, dst)
+        assert len(s) == 100
+        page, token = s.get_relation_tuples(
+            RelationQuery(namespace="n", object="o3")
+        )
+        assert len(page) == 1
+        assert page[0].subject == SubjectID("u3")
+        # subject filter
+        page, _ = s.get_relation_tuples(
+            RelationQuery(subject=SubjectID("u0"))
+        )
+        assert len(page) == 15  # u0 for i = 0, 7, 14, ..., 98
+
+    def test_bulk_mixed_subject_kinds(self):
+        s = ColumnarTupleStore()
+        s.bulk_load_edges(
+            [("n", "doc", "view"), ("n", "grp", "m")],
+            [("n", "grp", "m"), ("alice",)],
+        )
+        tuples = s.all_tuples()
+        assert t("n:doc#view@(n:grp#m)") in tuples
+        assert t("n:grp#m@alice") in tuples
+
+    def test_snapshot_ids_zero_object_path(self):
+        s = ColumnarTupleStore()
+        s.bulk_load_edges(
+            [("n", "a", "r"), ("n", "b", "r")], [("u1",), ("u2",)]
+        )
+        src, dst, vocab, version = s.snapshot_ids()
+        assert len(src) == len(dst) == 2
+        assert version == 1
+        assert vocab.key(int(src[0])) == ("n", "a", "r")
+        assert vocab.key(int(dst[0])) == ("u1",)
+
+    def test_snapshot_manager_sees_bulk_load(self):
+        s = ColumnarTupleStore()
+        mgr = SnapshotManager(s)
+        assert mgr.snapshot().num_edges == 0
+        s.bulk_load_edges([("n", "a", "r")], [("u1",)])
+        snap = mgr.snapshot()
+        assert snap.num_edges == 1
+
+    def test_bulk_duplicates_deduped_and_deletable(self):
+        """Duplicate pairs in the bulk input (and re-loads of existing
+        pairs) must collapse to one live row, so a later delete fully
+        revokes the grant — no ghost edges."""
+        s = ColumnarTupleStore()
+        s.bulk_load_edges(
+            [("n", "a", "r"), ("n", "a", "r"), ("n", "b", "r")],
+            [("u1",), ("u1",), ("u2",)],
+        )
+        assert len(s) == 2
+        s.bulk_load_edges([("n", "a", "r")], [("u1",)])  # re-load existing
+        assert len(s) == 2
+        mgr = SnapshotManager(s)
+        assert mgr.snapshot().num_edges == 2
+        s.delete_relation_tuples(t("n:a#r@u1"))
+        assert len(s) == 1
+        assert mgr.snapshot().num_edges == 1
+        page, _ = s.get_relation_tuples(RelationQuery(namespace="n", object="a"))
+        assert page == []
+
+    def test_delete_after_bulk_visible_in_snapshot(self):
+        s = ColumnarTupleStore()
+        s.bulk_load_edges([("n", "a", "r"), ("n", "b", "r")], [("u1",), ("u2",)])
+        mgr = SnapshotManager(s)
+        assert mgr.snapshot().num_edges == 2
+        s.delete_relation_tuples(t("n:a#r@u1"))
+        assert mgr.snapshot().num_edges == 1
+        assert len(s) == 1
+
+
+class TestEnginesOverColumnar:
+    @pytest.mark.parametrize("seed", range(2))
+    def test_closure_matches_host_oracle(self, seed):
+        rng = np.random.default_rng(seed + 400)
+        s = ColumnarTupleStore()
+        n_obj, n_usr = 20, 12
+        src, dst = [], []
+        for _ in range(200):
+            src.append((f"n", f"o{rng.integers(n_obj)}", f"r{rng.integers(3)}"))
+            if rng.random() < 0.45:
+                dst.append(
+                    ("n", f"o{rng.integers(n_obj)}", f"r{rng.integers(3)}")
+                )
+            else:
+                dst.append((f"u{rng.integers(n_usr)}",))
+        s.bulk_load_edges(src, dst)
+        host = CheckEngine(s, max_depth=5)
+        eng = ClosureCheckEngine(SnapshotManager(s), max_depth=5)
+        reqs = []
+        for _ in range(64):
+            obj = f"o{rng.integers(n_obj)}"
+            rel = f"r{rng.integers(3)}"
+            if rng.random() < 0.3:
+                sub = f"n:o{rng.integers(n_obj)}#r{rng.integers(3)}"
+            else:
+                sub = f"u{rng.integers(n_usr)}"
+            reqs.append(t(f"n:{obj}#{rel}@({sub})"))
+        expect = [host.subject_is_allowed(r) for r in reqs]
+        assert eng.batch_check(reqs) == expect
+
+    def test_incremental_write_after_bulk(self):
+        s = ColumnarTupleStore()
+        s.bulk_load_edges([("n", "doc", "view")], [("n", "grp", "m")])
+        eng = ClosureCheckEngine(SnapshotManager(s), max_depth=5)
+        req = t("n:doc#view@alice")
+        assert not eng.subject_is_allowed(req)
+        s.write_relation_tuples(t("n:grp#m@alice"))
+        assert eng.subject_is_allowed(req)
+        s.delete_relation_tuples(t("n:grp#m@alice"))
+        assert not eng.subject_is_allowed(req)
