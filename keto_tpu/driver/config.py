@@ -103,11 +103,30 @@ CONFIG_SCHEMA = {
             "type": "object",
             "properties": {
                 "mode": {
-                    "enum": ["device", "host", "auto", "dense", "scatter"]
+                    "enum": [
+                        "device",
+                        "host",
+                        "auto",
+                        "dense",
+                        "scatter",
+                        "closure",
+                        "sharded",
+                    ]
                 },
                 "dense_threshold": {"type": "integer", "minimum": 2},
                 "max_batch": {"type": "integer", "minimum": 1},
                 "batch_window_us": {"type": "number", "minimum": 0},
+                "interior_limit": {"type": "integer", "minimum": 2},
+                "query_mode": {"enum": ["auto", "host", "device"]},
+                "mesh": {
+                    "type": "object",
+                    "properties": {
+                        "data": {"type": "integer", "minimum": 1},
+                        # 0 = use all remaining devices on the edge axis
+                        "edge": {"type": "integer", "minimum": 0},
+                    },
+                    "additionalProperties": False,
+                },
             },
             "additionalProperties": False,
         },
@@ -128,6 +147,10 @@ DEFAULTS = {
     "engine.dense_threshold": 8192,
     "engine.max_batch": 4096,
     "engine.batch_window_us": 200,
+    "engine.interior_limit": 16384,
+    "engine.query_mode": "auto",
+    "engine.mesh.data": 1,
+    "engine.mesh.edge": 0,
 }
 
 

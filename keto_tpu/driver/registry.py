@@ -60,6 +60,12 @@ class Registry:
                 self._store = InMemoryTupleStore(
                     namespace_manager=self.namespace_manager()
                 )
+            elif dsn == "columnar":
+                from ..store.columnar import ColumnarTupleStore
+
+                self._store = ColumnarTupleStore(
+                    namespace_manager=self.namespace_manager()
+                )
             elif dsn.startswith("sqlite://"):
                 try:
                     from ..persistence.sqlite import SQLiteTupleStore
@@ -73,9 +79,9 @@ class Registry:
                 )
             else:
                 raise ErrMalformedInput(
-                    f"unsupported DSN {dsn!r}: this build supports 'memory' "
-                    "and 'sqlite://<path>' (postgres/mysql/cockroach drivers "
-                    "are not present in the runtime image)"
+                    f"unsupported DSN {dsn!r}: this build supports 'memory', "
+                    "'columnar', and 'sqlite://<path>' (postgres/mysql/"
+                    "cockroach drivers are not present in the runtime image)"
                 )
         return self._store
 
@@ -90,6 +96,27 @@ class Registry:
             mode = self.config.engine_mode()
             if mode == "host":
                 self._check_engine = CheckEngine(self.store(), max_depth=max_depth)
+            elif mode == "closure":
+                from ..engine.closure import ClosureCheckEngine
+
+                self._check_engine = ClosureCheckEngine(
+                    self.snapshots(),
+                    max_depth=max_depth,
+                    interior_limit=int(
+                        self.config.get("engine.interior_limit")
+                    ),
+                    query_mode=str(self.config.get("engine.query_mode")),
+                )
+            elif mode == "sharded":
+                from ..parallel import ShardedCheckEngine, make_mesh
+
+                data = int(self.config.get("engine.mesh.data"))
+                edge = int(self.config.get("engine.mesh.edge")) or None
+                self._check_engine = ShardedCheckEngine(
+                    self.snapshots(),
+                    mesh=make_mesh(data=data, edge=edge),
+                    max_depth=max_depth,
+                )
             else:
                 # 'device'/'auto' -> size-based propagation choice;
                 # 'dense'/'scatter' force that propagation path
@@ -121,7 +148,12 @@ class Registry:
         direct on the host path."""
         if self._checker is None:
             engine = self.check_engine()
-            if isinstance(engine, DeviceCheckEngine):
+            if isinstance(engine, CheckEngine):
+                # host oracle: per-request evaluation, nothing to batch
+                self._checker = _DirectChecker(engine)
+            else:
+                # device-backed engines (frontier/closure/sharded) amortize
+                # per-batch costs — route through the batching seam
                 self._batcher = CheckBatcher(
                     engine,
                     max_batch=int(self.config.get("engine.max_batch")),
@@ -129,8 +161,6 @@ class Registry:
                     / 1e6,
                 )
                 self._checker = self._batcher
-            else:
-                self._checker = _DirectChecker(engine)
         return self._checker
 
     def snaptoken(self) -> str:
@@ -205,11 +235,13 @@ class Registry:
 
     async def start_all(self) -> tuple[int, int]:
         """Start both planes; returns (read_port, write_port). Pre-warms the
-        device kernel so the first request doesn't pay XLA compile latency."""
+        device kernels at PRODUCTION shapes (the configured max_batch bucket
+        and the smallest bucket) so live traffic never pays XLA compiles."""
         engine = self.check_engine()
         if hasattr(engine, "warmup"):
+            max_batch = int(self.config.get("engine.max_batch"))
             await asyncio.get_running_loop().run_in_executor(
-                None, engine.warmup
+                None, lambda: engine.warmup(max_batch)
             )
         read_port = await self.read_plane().start()
         write_port = await self.write_plane().start()

@@ -110,15 +110,20 @@ class DeviceCheckEngine:
 
     # -- public API ----------------------------------------------------------
 
-    def warmup(self) -> None:
-        """Compile the kernel for the current snapshot shape (first XLA
-        compile is tens of seconds; serve paths call this at boot so the
-        first request doesn't pay it)."""
+    def warmup(self, batch: int = 1) -> None:
+        """Compile the kernel for the current snapshot shape at production
+        batch buckets (first XLA compile is tens of seconds; serve paths
+        call this at boot so live traffic never pays it). Warms the `batch`
+        bucket — the configured maximum — and the smallest bucket, which
+        light traffic hits."""
         dummy = RelationTuple(
             namespace="", object="", relation="",
             subject=SubjectSet(namespace="", object="", relation=""),
         )
-        self.batch_check([dummy])
+        batch = max(1, batch)
+        self.batch_check([dummy] * batch)
+        if _bucket_batch(batch) != _bucket_batch(1):
+            self.batch_check([dummy])
 
     def subject_is_allowed(
         self, requested: RelationTuple, max_depth: int = 0

@@ -33,7 +33,7 @@ from jax import shard_map
 
 from ..engine.check import DEFAULT_MAX_DEPTH, clamp_depth
 from ..graph.snapshot import GraphSnapshot, SnapshotManager
-from ..relationtuple.definitions import RelationTuple
+from ..relationtuple.definitions import RelationTuple, SubjectSet
 
 
 def make_mesh(
@@ -208,3 +208,14 @@ class ShardedCheckEngine:
         self, requested: RelationTuple, max_depth: int = 0
     ) -> bool:
         return self.batch_check([requested], max_depth)[0]
+
+    def warmup(self, batch: int = 1) -> None:
+        """Compile the sharded kernel at production batch buckets."""
+        dummy = RelationTuple(
+            namespace="", object="", relation="",
+            subject=SubjectSet(namespace="", object="", relation=""),
+        )
+        batch = max(1, batch)
+        self.batch_check([dummy] * batch)
+        if self._bucket_batch(batch) != self._bucket_batch(1):
+            self.batch_check([dummy])
