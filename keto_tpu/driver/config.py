@@ -118,6 +118,9 @@ CONFIG_SCHEMA = {
                 "batch_window_us": {"type": "number", "minimum": 0},
                 "interior_limit": {"type": "integer", "minimum": 2},
                 "query_mode": {"enum": ["auto", "host", "device"]},
+                "freshness": {"enum": ["auto", "strong", "bounded"]},
+                "strong_freshness_edges": {"type": "integer", "minimum": 0},
+                "rebuild_debounce_ms": {"type": "number", "minimum": 0},
                 "mesh": {
                     "type": "object",
                     "properties": {
@@ -143,12 +146,15 @@ DEFAULTS = {
     "serve.write.host": "",
     "log.level": "info",
     "namespaces": [],
-    "engine.mode": "device",
+    "engine.mode": "closure",
     "engine.dense_threshold": 8192,
     "engine.max_batch": 4096,
     "engine.batch_window_us": 200,
     "engine.interior_limit": 16384,
     "engine.query_mode": "auto",
+    "engine.freshness": "auto",
+    "engine.strong_freshness_edges": 1 << 21,
+    "engine.rebuild_debounce_ms": 50,
     "engine.mesh.data": 1,
     "engine.mesh.edge": 0,
 }

@@ -22,6 +22,23 @@ queries from numpy (zero device round-trips on the hot path); ``auto``
 probes the link at first use and picks. The expensive O(M^3) closure BUILD
 always runs on the accelerator.
 
+Write-path freshness (``freshness``): every write advances the store version
+and invalidates the closure. Three policies:
+
+- ``strong``  — the next check rebuilds synchronously before answering
+  (exact read-your-writes; a stall at large graph sizes).
+- ``bounded`` — checks keep serving the previous snapshot's closure while a
+  background thread rebuilds; the served store version is exposed via
+  ``served_version()`` so the Check snaptoken honestly names the snapshot
+  that answered (the Zanzibar zookie contract the reference stubs out).
+- ``auto``    — strong below ``strong_freshness_edges`` live edges (tests,
+  small tenants: rebuilds are microseconds), bounded above it.
+
+Rebuilds themselves are cheap when they can be: an append-only delta whose
+new interior edges connect *existing* interior nodes updates the resident
+closure in O(M^2) per edge (ops.closure.closure_insert_edge — exact for
+single-edge insertion) instead of re-running the O(M^3) matmul build.
+
 Requests whose F0/L rows overflow the padded width, and snapshots whose
 interior exceeds ``interior_limit`` (closure memory is O(M^2)), fall back to
 an exact slower engine — by default the host BFS oracle over the same store.
@@ -31,7 +48,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Optional, Sequence
+from dataclasses import dataclass
+from typing import Optional, Sequence, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -41,6 +59,8 @@ from ..graph.snapshot import GraphSnapshot, SnapshotManager
 from ..ops.closure import (
     INF_DIST,
     build_closure_packed,
+    closure_insert_edge,
+    closure_insert_edge_host,
     closure_query,
     pack_adjacency,
 )
@@ -55,6 +75,10 @@ _PROBE_SLOW_S = 0.005  # dispatch+transfer slower than this -> host queries
 # the closure stores distances in uint8 with INF_DIST=255 reserved, so the
 # deepest resolvable path is 254 interior steps
 _MAX_CLOSURE_DEPTH = INF_DIST
+
+# incremental closure updates are O(M^2) numpy/device work per new interior
+# edge; past this many new edges the O(M^3) full rebuild wins back
+_MAX_INCR_EDGES = 8
 
 
 def _bucket_pow2(n: int, minimum: int = _MIN_BATCH) -> int:
@@ -77,29 +101,63 @@ def _probe_roundtrip_slow() -> bool:
 
 
 class _ClosureArtifacts:
-    """Per-snapshot residency: interior decomposition + closure matrix."""
+    """Per-snapshot residency: the snapshot itself (bounded-freshness serving
+    answers against it, not the live store), interior decomposition, and the
+    closure matrix on device and/or host."""
 
     def __init__(
-        self, snap: GraphSnapshot, ig: InteriorGraph, k_max: int, host: bool
+        self,
+        snap: GraphSnapshot,
+        ig: InteriorGraph,
+        k_max: int,
+        host: bool,
+        d=None,
+        d_host: Optional[np.ndarray] = None,
     ):
-        self.host_src = snap.src  # identity keys for the cache
-        self.host_dst = snap.dst
+        self.snap = snap
         self.ig = ig
+        self.k_max = k_max
         # pad so at least one INF row exists (the PAD index target)
         self.m_pad = _bucket_mult(ig.m + 1, 256)
         self.pad = self.m_pad - 1
-        packed = pack_adjacency(ig.ii_src, ig.ii_dst, self.m_pad)
-        self.d = build_closure_packed(
-            jnp.asarray(packed),
-            jnp.int32(ig.m),
-            m_pad=self.m_pad,
-            k_max=k_max,
-        )
-        # host query mode: one D download per snapshot, then the hot path
-        # never touches the device
-        self.d_host: Optional[np.ndarray] = (
-            np.asarray(self.d) if host else None
-        )
+        if d is None and d_host is None:
+            packed = pack_adjacency(ig.ii_src, ig.ii_dst, self.m_pad)
+            d = build_closure_packed(
+                jnp.asarray(packed),
+                jnp.int32(ig.m),
+                m_pad=self.m_pad,
+                k_max=k_max,
+            )
+        if host:
+            # one D download per snapshot, then the hot path never touches
+            # the device; the device copy is dropped (it would double the
+            # per-snapshot footprint, ~m_pad^2 bytes each)
+            self.d = None
+            self.d_host = np.asarray(d) if d_host is None else d_host
+        else:
+            self.d = d
+            self.d_host = None
+
+    @property
+    def version(self) -> int:
+        return self.snap.version
+
+    @property
+    def num_edges(self) -> int:
+        return self.snap.num_edges
+
+
+@dataclass
+class _TooBig:
+    """Snapshot whose interior exceeds the closure limit (or whose depth
+    exceeds the uint8 range): checks route to the exact fallback engine,
+    which reads the live store — always fresh."""
+
+    version: int
+    num_edges: int
+
+
+_State = Union[_ClosureArtifacts, _TooBig]
 
 
 class ClosureCheckEngine:
@@ -111,6 +169,9 @@ class ClosureCheckEngine:
         f0_max: int = 32,
         l_max: int = 32,
         query_mode: str = "auto",  # auto | host | device
+        freshness: str = "auto",  # auto | strong | bounded
+        strong_freshness_edges: int = 1 << 21,
+        rebuild_debounce_s: float = 0.05,
         fallback=None,
     ):
         self.snapshots = snapshots
@@ -120,14 +181,23 @@ class ClosureCheckEngine:
         self.l_max = l_max
         if query_mode not in ("auto", "host", "device"):
             raise ValueError(f"unknown query_mode {query_mode!r}")
+        if freshness not in ("auto", "strong", "bounded"):
+            raise ValueError(f"unknown freshness {freshness!r}")
         self.query_mode = query_mode
+        self.freshness = freshness
+        self.strong_freshness_edges = strong_freshness_edges
+        self.rebuild_debounce_s = rebuild_debounce_s
         self._host_queries: Optional[bool] = (
             None if query_mode == "auto" else query_mode == "host"
         )
-        self._lock = threading.Lock()
-        self._cached: Optional[_ClosureArtifacts] = None
-        self._cached_none_key = None  # snapshot arrays too big for closure
+        self._lock = threading.Lock()  # guards _rebuilding
+        self._build_lock = threading.Lock()  # serializes state builds
+        self._state: Optional[_State] = None
+        self._rebuilding = False
         self._fallback = fallback
+        # build telemetry (read by tests and the metrics endpoint)
+        self.n_full_builds = 0
+        self.n_incremental_builds = 0
 
     # -- residency ------------------------------------------------------------
 
@@ -143,44 +213,171 @@ class ClosureCheckEngine:
             )
         return self._fallback
 
-    def _artifacts(self, snap: GraphSnapshot) -> Optional[_ClosureArtifacts]:
-        with self._lock:
-            cached = self._cached
+    def served_version(self) -> int:
+        """The store version checks are currently answered at. Equals the
+        live store version except in bounded freshness mid-rebuild, where it
+        names the (older) snapshot still serving — the honest snaptoken."""
+        state = self._state
+        if isinstance(state, _ClosureArtifacts):
+            return state.version
+        return self.snapshots.store.version
+
+    def _bounded(self, state: Optional[_State]) -> bool:
+        if state is None:
+            return False  # nothing to serve stale from: must build
+        if self.freshness == "strong":
+            return False
+        if self.freshness == "bounded":
+            return True
+        return state.num_edges >= self.strong_freshness_edges
+
+    def _serving(self) -> _State:
+        """The state answering this check — fresh, or stale-with-rebuild
+        under bounded freshness. Never stalls on a rebuild once a state
+        exists and the policy is bounded."""
+        state = self._state
+        store_version = self.snapshots.store.version
+        if state is not None and state.version == store_version:
+            return state
+        if self._bounded(state):
+            self._kick_rebuild()
+            return state
+        return self._build_sync()
+
+    def _build_sync(self) -> _State:
+        with self._build_lock:
+            state = self._state
             if (
-                cached is not None
-                and cached.host_src is snap.src
-                and cached.host_dst is snap.dst
+                state is not None
+                and state.version == self.snapshots.store.version
             ):
-                return cached
-            if self._cached_none_key is not None and (
-                self._cached_none_key[0] is snap.src
-                and self._cached_none_key[1] is snap.dst
-            ):
-                return None
-            ig = build_interior(snap)
-            if ig.m > self.interior_limit or (
-                self.global_max_depth > _MAX_CLOSURE_DEPTH
-            ):
-                # depths beyond the uint8 distance range cannot be resolved
-                # by the closure — exact fallback for the whole snapshot
-                self._cached_none_key = (snap.src, snap.dst)
-                self._cached = None
-                return None
-            art = _ClosureArtifacts(
-                snap, ig, self.global_max_depth - 1, self.host_queries()
+                return state  # a concurrent builder got there first
+            snap = self.snapshots.snapshot()
+            state = self._build_state(snap, prev=self._state)
+            self._state = state
+            return state
+
+    def _kick_rebuild(self) -> None:
+        with self._lock:
+            if self._rebuilding:
+                return
+            self._rebuilding = True
+        threading.Thread(
+            target=self._rebuild_worker, name="closure-rebuild", daemon=True
+        ).start()
+
+    def _rebuild_worker(self) -> None:
+        try:
+            while True:
+                if self.rebuild_debounce_s > 0:
+                    time.sleep(self.rebuild_debounce_s)  # coalesce bursts
+                state = self._build_sync()
+                # exit check and flag clear are atomic wrt _kick_rebuild:
+                # otherwise a write landing between them would see
+                # _rebuilding=True, skip the kick, and strand a stale state
+                with self._lock:
+                    if self.snapshots.store.version == state.version:
+                        self._rebuilding = False
+                        return
+        except BaseException:
+            with self._lock:
+                self._rebuilding = False
+            raise
+
+    def _build_state(
+        self, snap: GraphSnapshot, prev: Optional[_State]
+    ) -> _State:
+        ig = build_interior(snap)
+        if ig.m > self.interior_limit or (
+            self.global_max_depth > _MAX_CLOSURE_DEPTH
+        ):
+            # depths beyond the uint8 distance range cannot be resolved
+            # by the closure — exact fallback for the whole snapshot
+            return _TooBig(version=snap.version, num_edges=snap.num_edges)
+        k_max = self.global_max_depth - 1
+        host = self.host_queries()
+        if isinstance(prev, _ClosureArtifacts):
+            new_ii = self._appended_interior_edges(prev, snap, ig)
+            if new_ii is not None and len(new_ii) <= _MAX_INCR_EDGES:
+                self.n_incremental_builds += 1
+                return self._incremental_artifacts(
+                    prev, snap, ig, k_max, host, new_ii
+                )
+        self.n_full_builds += 1
+        return _ClosureArtifacts(snap, ig, k_max, host)
+
+    @staticmethod
+    def _appended_interior_edges(
+        prev: _ClosureArtifacts, snap: GraphSnapshot, ig: InteriorGraph
+    ) -> Optional[np.ndarray]:
+        """If `snap` is an append-only extension of prev.snap with the same
+        interior node set, the interior-index pairs of its new interior
+        edges (possibly empty); else None (full rebuild required)."""
+        old = prev.snap
+        pe = old.num_edges
+        if (
+            snap.vocab is not old.vocab
+            or snap.padded_nodes != old.padded_nodes
+            or snap.num_edges < pe
+            or not np.array_equal(snap.src[:pe], old.src[:pe])
+            or not np.array_equal(snap.dst[:pe], old.dst[:pe])
+            or not np.array_equal(ig.interior_ids, prev.ig.interior_ids)
+        ):
+            return None
+        src = snap.src[pe : snap.num_edges]
+        dst = snap.dst[pe : snap.num_edges]
+        si = ig.interior_index[src]
+        di = ig.interior_index[dst]
+        both = (si >= 0) & (di >= 0)
+        return np.stack([si[both], di[both]], axis=1)
+
+    def _incremental_artifacts(
+        self,
+        prev: _ClosureArtifacts,
+        snap: GraphSnapshot,
+        ig: InteriorGraph,
+        k_max: int,
+        host: bool,
+        new_ii: np.ndarray,
+    ) -> _ClosureArtifacts:
+        """Reuse the resident closure: per-edge exact O(M^2) updates instead
+        of the O(M^3) rebuild. The interior CSRs/edge keys were already
+        rebuilt vectorized by build_interior (O(E)); only D carries over."""
+        if host:
+            d_host = prev.d_host
+            if len(new_ii):
+                d_host = d_host.copy()
+                for u, v in new_ii:
+                    closure_insert_edge_host(d_host, int(u), int(v), k_max)
+            return _ClosureArtifacts(
+                snap, ig, k_max, host=True, d_host=d_host
             )
-            self._cached = art
-            self._cached_none_key = None
-            return art
+        d = prev.d
+        for u, v in new_ii:
+            d = closure_insert_edge(
+                d, jnp.int32(u), jnp.int32(v), jnp.int32(k_max)
+            )
+        return _ClosureArtifacts(snap, ig, k_max, host=False, d=d)
 
     def warmup(self, batch: int = 1) -> None:
         """Build the closure for the current snapshot and compile/prime the
-        query path for `batch` (serve paths call this at boot)."""
+        query path (serve paths call this at boot). In device query mode
+        every pow2 batch bucket up to `batch` is compiled; per-(f0, l) width
+        shapes still compile on first live hit (they depend on the batch's
+        actual fan-out)."""
         dummy = RelationTuple(
             namespace="", object="", relation="",
             subject=SubjectSet(namespace="", object="", relation=""),
         )
-        self.batch_check([dummy] * max(1, batch))
+        self.batch_check([dummy])
+        if isinstance(self._state, _ClosureArtifacts) and not self.host_queries():
+            # cover the bucket live batches actually pad into, even when
+            # max_batch itself is not a power of two
+            top = _bucket_pow2(max(batch, _MIN_BATCH))
+            b = _MIN_BATCH
+            while b <= top:
+                self.batch_check([dummy] * b)
+                b *= 2
 
     # -- public API -----------------------------------------------------------
 
@@ -197,12 +394,14 @@ class ClosureCheckEngine:
     ) -> list[bool]:
         if not requests:
             return []
-        snap = self.snapshots.snapshot()
-        art = self._artifacts(snap)
-        if art is None:  # interior too large for a closure: exact fallback
+        state = self._serving()
+        if not isinstance(state, _ClosureArtifacts):
+            # interior too large for a closure: exact fallback
             return self.fallback_engine().batch_check(
                 requests, max_depth, depths
             )
+        art = state
+        snap = art.snap
         n = len(requests)
         pn = snap.padded_nodes
         dummy = snap.dummy_node
@@ -260,9 +459,9 @@ class ClosureCheckEngine:
         array-level clients and the data-parallel sharded serving tier.
         Unknown nodes must already be mapped to the snapshot's dummy id.
         """
-        snap = self.snapshots.snapshot()
-        art = self._artifacts(snap)
         start = np.asarray(start, dtype=np.int64)
+        if len(start) == 0:
+            return np.zeros(0, dtype=bool)
         target = np.asarray(target, dtype=np.int64)
         is_id = np.asarray(is_id, dtype=bool)
         gmax = self.global_max_depth
@@ -273,9 +472,9 @@ class ClosureCheckEngine:
             depth = np.where((want <= 0) | (want > gmax), gmax, want).astype(
                 np.int32
             )
-        if len(start) == 0:
-            return np.zeros(0, dtype=bool)
-        if art is None:
+        state = self._serving()
+        if not isinstance(state, _ClosureArtifacts):
+            snap = self.snapshots.snapshot()
             reqs = self._decode_requests(snap, start, target)
             res = np.asarray(
                 self.fallback_engine().batch_check(
@@ -289,6 +488,14 @@ class ClosureCheckEngine:
             n_snap = min(snap.num_nodes, snap.dummy_node)
             res[(start >= n_snap) | (target >= n_snap)] = False
             return res
+        art = state
+        snap = art.snap
+        # ids interned after this snapshot (or by a caller on a newer one)
+        # are unknown here: clamp to the inert dummy node
+        start = np.where(start >= snap.padded_nodes, snap.dummy_node, start)
+        target = np.where(
+            target >= snap.padded_nodes, snap.dummy_node, target
+        )
         return self._check_arrays(snap, art, start, target, is_id, depth)
 
     def _decode_requests(self, snap, start, target) -> list[RelationTuple]:
@@ -374,7 +581,7 @@ class ClosureCheckEngine:
 
     @staticmethod
     def _adaptive_width(indptr, rows, cap: int) -> int:
-        deg_max = int(np.max(indptr[rows + 1] - indptr[rows]), )
+        deg_max = int(np.max(indptr[rows + 1] - indptr[rows]))
         width = 1 << max(deg_max - 1, 0).bit_length() if deg_max > 1 else 1
         return min(max(width, 1), cap)
 

@@ -106,6 +106,34 @@ def _closure_from_dense(adj, m, m_pad, k_max):
 
 
 @jax.jit
+def closure_insert_edge(d, u, v, k_max):
+    """Exact incremental update of a bounded closure for one inserted
+    interior edge u -> v (device path).
+
+    For a single nonnegative-weight edge insertion the all-pairs update
+    D'[i,j] = min(D[i,j], D[i,u] + 1 + D[v,j]) is exact (a shortest path
+    uses the new edge at most once). Distances beyond k_max are clamped to
+    INF_DIST, preserving the bounded-closure invariant. O(M^2) instead of
+    the O(M^3) full rebuild — the write-path fix for closure thrash.
+    """
+    col = d[:, u].astype(jnp.int32)
+    row = d[v, :].astype(jnp.int32)
+    cand = col[:, None] + 1 + row[None, :]
+    cand = jnp.where(cand > k_max, jnp.int32(INF_DIST), cand)
+    return jnp.minimum(d, cand.astype(jnp.uint8))
+
+
+def closure_insert_edge_host(d, u: int, v: int, k_max: int):
+    """Numpy twin of closure_insert_edge (host query mode), in place."""
+    import numpy as np
+
+    cand = d[:, u].astype(np.int32)[:, None] + 1 + d[v, :].astype(np.int32)[None, :]
+    cand = np.where(cand > k_max, np.int32(INF_DIST), cand).astype(np.uint8)
+    np.minimum(d, cand, out=d)
+    return d
+
+
+@jax.jit
 def closure_query(d, f0, l, extra, depth, direct):
     """allowed: bool[B] — device-side query (cheap-dispatch deployments).
 

@@ -15,7 +15,7 @@ class TestConfigDefaults:
     def test_missing_key_without_default_uses_defaults_table(self):
         c = Config(values={}, env={})
         assert c.get("serve.read.port") == 4466
-        assert c.get("engine.mode") == "device"
+        assert c.get("engine.mode") == "closure"
         assert c.get("no.such.key") is None
 
     def test_data_value_wins_over_default(self):
