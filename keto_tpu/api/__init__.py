@@ -27,6 +27,7 @@ from ory.keto.acl.v1alpha1 import (  # noqa: E402
     write_service_pb2,
 )
 from health import health_pb2  # noqa: E402
+from reflection import reflection_pb2  # noqa: E402
 
 __all__ = [
     "acl_pb2",

@@ -20,7 +20,10 @@ from typing import Optional
 import grpc
 from aiohttp import web
 
+from .interceptors import TelemetryInterceptor
+from .reflection import add_reflection_service
 from .services import (
+    _PKG,
     CheckServicer,
     ExpandServicer,
     HealthServicer,
@@ -37,21 +40,45 @@ from .services import (
 
 _H2_PREFACE_HEAD = b"PRI "
 
+_HEALTH = "grpc.health.v1.Health"
+READ_SERVICES = (
+    f"{_PKG}.CheckService",
+    f"{_PKG}.ExpandService",
+    f"{_PKG}.ReadService",
+    f"{_PKG}.VersionService",
+    _HEALTH,
+)
+WRITE_SERVICES = (
+    f"{_PKG}.WriteService",
+    f"{_PKG}.VersionService",
+    _HEALTH,
+)
+
 
 class _MuxedPort:
-    """One public port -> loopback gRPC + REST backends."""
+    """One public port -> loopback gRPC + REST backends.
 
-    def __init__(self, host: str, port: int, grpc_port: int, http_port: int):
+    With an ``ssl_context`` the mux is also the TLS terminator: the public
+    port speaks TLS for both protocols (the sniffing happens on decrypted
+    bytes), the loopback backends stay plaintext — the usual
+    edge-termination layout, and the only one compatible with protocol
+    sniffing."""
+
+    def __init__(
+        self, host: str, port: int, grpc_port: int, http_port: int,
+        ssl_context=None,
+    ):
         self.host = host
         self.port = port
         self.grpc_port = grpc_port
         self.http_port = http_port
+        self.ssl_context = ssl_context
         self._server: Optional[asyncio.base_events.Server] = None
         self._conns: set[asyncio.Task] = set()
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(
-            self._handle, self.host, self.port
+            self._handle, self.host, self.port, ssl=self.ssl_context
         )
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
@@ -124,57 +151,98 @@ class _MuxedPort:
                     pass
 
 
+def _interceptors(plane, logger, metrics, tracer):
+    if logger is None and metrics is None and tracer is None:
+        return ()
+    return (
+        TelemetryInterceptor(
+            plane, logger=logger, metrics=metrics, tracer=tracer
+        ),
+    )
+
+
 def build_read_grpc_server(
     checker, expand_engine, manager, snaptoken_fn, version: str,
     health: HealthServicer, max_workers: int = 32,
+    logger=None, metrics=None, tracer=None,
 ) -> grpc.Server:
-    """Read-plane gRPC: Check + Expand + Read + Version + Health (reference
-    ReadGRPCServer, registry_default.go:369-385)."""
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    """Read-plane gRPC: Check + Expand + Read + Version + Health +
+    reflection, behind the telemetry interceptor chain (reference
+    ReadGRPCServer + interceptors, registry_default.go:337-385)."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        interceptors=_interceptors("read", logger, metrics, tracer),
+    )
     add_check_service(server, CheckServicer(checker, snaptoken_fn))
     add_expand_service(server, ExpandServicer(expand_engine, snaptoken_fn))
     add_read_service(server, ReadServicer(manager))
     add_version_service(server, VersionServicer(version))
     add_health_service(server, health)
+    add_reflection_service(server, READ_SERVICES)
     return server
 
 def build_write_grpc_server(
     manager, snaptoken_fn, version: str,
     health: HealthServicer, max_workers: int = 32,
+    logger=None, metrics=None, tracer=None,
 ) -> grpc.Server:
-    """Write-plane gRPC: Write + Version + Health (reference WriteGRPCServer,
-    registry_default.go:387-401)."""
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    """Write-plane gRPC: Write + Version + Health + reflection (reference
+    WriteGRPCServer, registry_default.go:387-401)."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        interceptors=_interceptors("write", logger, metrics, tracer),
+    )
     add_write_service(server, WriteServicer(manager, snaptoken_fn))
     add_version_service(server, VersionServicer(version))
     add_health_service(server, health)
+    add_reflection_service(server, WRITE_SERVICES)
     return server
 
 
 class PlaneServer:
-    """One serving plane (read or write): gRPC + REST behind one muxed port."""
+    """One serving plane (read or write): gRPC + REST behind one muxed port.
+
+    The muxed port is the compatibility surface (one port, both protocols,
+    like the reference's cmux). The direct backend ports are also exposed
+    (``grpc_port``/``http_port``) for throughput-critical clients: the mux
+    relays bytes through the event loop, which costs two copies per message
+    — deployments that front the planes with a protocol-aware LB should
+    target the direct ports."""
 
     def __init__(
         self, grpc_server: grpc.Server, app: web.Application,
-        host: str = "0.0.0.0", port: int = 0,
+        host: str = "0.0.0.0", port: int = 0, ssl_context=None,
     ):
         self.grpc_server = grpc_server
         self.app = app
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
+        self.grpc_port: int = 0
+        self.http_port: int = 0
         self._runner: Optional[web.AppRunner] = None
         self._mux: Optional[_MuxedPort] = None
 
     async def start(self) -> int:
-        grpc_port = self.grpc_server.add_insecure_port("127.0.0.1:0")
+        # with TLS the plaintext backends must not be reachable off-host:
+        # the muxed port is then the only public surface
+        backend_host = (
+            "127.0.0.1" if self.ssl_context else (self.host or "0.0.0.0")
+        )
+        self.grpc_port = self.grpc_server.add_insecure_port(
+            f"{backend_host}:0"
+        )
         self.grpc_server.start()
         # bounded graceful shutdown: don't wait out idle keep-alive clients
         self._runner = web.AppRunner(self.app, shutdown_timeout=2.0)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        site = web.TCPSite(self._runner, backend_host, 0)
         await site.start()
-        http_port = site._server.sockets[0].getsockname()[1]
-        self._mux = _MuxedPort(self.host, self.port, grpc_port, http_port)
+        self.http_port = site._server.sockets[0].getsockname()[1]
+        self._mux = _MuxedPort(
+            self.host, self.port, self.grpc_port, self.http_port,
+            ssl_context=self.ssl_context,
+        )
         self.port = await self._mux.start()
         return self.port
 

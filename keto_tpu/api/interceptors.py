@@ -1,0 +1,112 @@
+"""gRPC server interceptor chain: request logging + metrics + error parity.
+
+The reference builds an interceptor chain per server — herodot error
+unwrap, logrus request logging, opentracing, telemetry (reference
+internal/driver/registry_default.go:337-367). Python's grpc server takes
+interceptors at construction; this module provides the equivalent chain:
+
+- every finished RPC emits a structured log line (method, code, ms) and a
+  ``keto_grpc_requests_total{plane,method,code}`` count + duration
+  histogram observation;
+- a tracing span wraps the handler, parenting any engine-phase spans the
+  call produces;
+- uncaught KetoError escaping a handler maps to its canonical status code
+  (the servicers already map errors at the call site; the interceptor is
+  the backstop that guarantees parity for any future handler).
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+
+from ..utils.errors import KetoError
+
+
+class TelemetryInterceptor(grpc.ServerInterceptor):
+    def __init__(self, plane: str, logger=None, metrics=None, tracer=None):
+        self.plane = plane
+        self.logger = logger
+        self.tracer = tracer
+        if metrics is not None:
+            self._requests = metrics.counter(
+                "keto_grpc_requests_total",
+                "gRPC requests by plane/method/code",
+                labelnames=("plane", "method", "code"),
+            )
+            self._duration = metrics.histogram(
+                "keto_grpc_request_duration_seconds",
+                "gRPC request duration",
+                labelnames=("plane",),
+            )
+        else:
+            self._requests = None
+            self._duration = None
+
+    def _observe(self, method: str, code: str, elapsed: float) -> None:
+        if self._requests is not None:
+            self._requests.labels(
+                plane=self.plane, method=method, code=code
+            ).inc()
+            self._duration.labels(plane=self.plane).observe(elapsed)
+        if self.logger is not None:
+            self.logger.info(
+                "grpc",
+                plane=self.plane,
+                method=method,
+                code=code,
+                ms=round(1000 * elapsed, 2),
+            )
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or not handler.unary_unary:
+            # streaming handlers (health Watch, reflection) pass through
+            # un-instrumented: their lifetime is the stream, not a request
+            return handler
+        method = handler_call_details.method
+        inner = handler.unary_unary
+
+        def wrapped(request, context):
+            t0 = time.perf_counter()
+            code = "OK"
+            span = (
+                self.tracer.span("grpc.request", method=method)
+                if self.tracer is not None
+                else None
+            )
+            try:
+                if span is not None:
+                    with span:
+                        return inner(request, context)
+                return inner(request, context)
+            except KetoError as e:
+                # error parity backstop: KetoError -> canonical status
+                code = e.grpc_code
+                context.abort(
+                    getattr(
+                        grpc.StatusCode, e.grpc_code, grpc.StatusCode.INTERNAL
+                    ),
+                    e.message,
+                )
+            except Exception:
+                # context.abort raises to unwind the stack — the servicers'
+                # own abort calls land here; report the set code when the
+                # grpc version exposes it
+                code = "INTERNAL"
+                try:
+                    set_code = context.code()
+                    if set_code is not None:
+                        code = set_code.name
+                except Exception:
+                    pass
+                raise
+            finally:
+                self._observe(method, code, time.perf_counter() - t0)
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )

@@ -5,6 +5,11 @@ Read port (reference RegisterReadRoutes):
 - GET  /relation-tuples               paginated query (read_server.go:114-154)
 - GET  /check, POST /check            200 {"allowed":true} / 403 {"allowed":false}
                                       (check/handler.go:92-166)
+- POST /check/batch                   keto_tpu extension: one request carrying
+                                      many checks -> {"allowed": [...]}. The
+                                      engine is batch-native; this lets the
+                                      wire amortize the same way instead of
+                                      paying per-RPC overhead per check.
 - GET  /expand                        subject tree or null (expand/handler.go:77-91)
 
 Write port (reference RegisterWriteRoutes):
@@ -41,6 +46,7 @@ from ..utils.pagination import PaginationOptions
 
 ROUTE_TUPLES = "/relation-tuples"
 ROUTE_CHECK = "/check"
+ROUTE_CHECK_BATCH = "/check/batch"
 ROUTE_EXPAND = "/expand"
 
 
@@ -67,6 +73,61 @@ async def error_middleware(request: web.Request, handler):
             },
             status=500,
         )
+
+
+def make_telemetry_middleware(plane: str, logger=None, metrics=None):
+    """Request logging + metrics, outermost so it sees final status codes
+    (reference reqlog middleware, registry_default.go:276,307). Metric
+    labels use the matched route pattern, never the raw path — raw paths
+    are unbounded-cardinality."""
+    if metrics is not None:
+        requests_total = metrics.counter(
+            "keto_http_requests_total",
+            "HTTP requests by plane/method/route/code",
+            labelnames=("plane", "method", "route", "code"),
+        )
+        duration = metrics.histogram(
+            "keto_http_request_duration_seconds",
+            "HTTP request duration",
+            labelnames=("plane",),
+        )
+
+    @web.middleware
+    async def telemetry_middleware(request: web.Request, handler):
+        import time
+
+        t0 = time.perf_counter()
+        status = 500
+        try:
+            resp = await handler(request)
+            status = resp.status
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        finally:
+            elapsed = time.perf_counter() - t0
+            resource = request.match_info.route.resource
+            route = resource.canonical if resource is not None else "unmatched"
+            if metrics is not None:
+                requests_total.labels(
+                    plane=plane,
+                    method=request.method,
+                    route=route,
+                    code=str(status),
+                ).inc()
+                duration.labels(plane=plane).observe(elapsed)
+            if logger is not None:
+                logger.info(
+                    "http",
+                    plane=plane,
+                    method=request.method,
+                    route=route,
+                    code=status,
+                    ms=round(1000 * elapsed, 2),
+                )
+
+    return telemetry_middleware
 
 
 def make_cors_middleware(cfg: Optional[dict]):
@@ -177,6 +238,7 @@ class ReadAPI:
         app.router.add_get(ROUTE_TUPLES, self.get_relations)
         app.router.add_get(ROUTE_CHECK, self.get_check)
         app.router.add_post(ROUTE_CHECK, self.post_check)
+        app.router.add_post(ROUTE_CHECK_BATCH, self.post_check_batch)
         app.router.add_get(ROUTE_EXPAND, self.get_expand)
 
     async def get_relations(self, request: web.Request) -> web.Response:
@@ -211,6 +273,31 @@ class ReadAPI:
         tup = RelationTuple.from_dict(body)
         return await self._check_response(
             tup, max_depth_from_query(request.rel_url.query)
+        )
+
+    async def post_check_batch(self, request: web.Request) -> web.Response:
+        """keto_tpu extension: many checks per request. Body is either a
+        bare json array of relation tuples or {"tuples": [...],
+        "max_depth": n}. Response: {"allowed": [...], "snaptoken": "..."}
+        with answers in request order, always 200 (per-item allow/deny is
+        in the body, unlike the single check's 200/403)."""
+        body = await _json_body(request)
+        max_depth = max_depth_from_query(request.rel_url.query)
+        if isinstance(body, dict):
+            items = body.get("tuples")
+            max_depth = int(body.get("max_depth", max_depth) or max_depth)
+        else:
+            items = body
+        if not isinstance(items, list):
+            raise ErrMalformedInput(
+                "expected a json array of relation tuples"
+            )
+        tuples = [RelationTuple.from_dict(d) for d in items]
+        allowed = await asyncio.get_running_loop().run_in_executor(
+            self.executor, self.checker.check_batch, tuples, max_depth
+        )
+        return web.json_response(
+            {"allowed": allowed, "snaptoken": self.snaptoken_fn()}
         )
 
     async def _check_response(
@@ -310,9 +397,12 @@ def _tuple_location_query(t: RelationTuple) -> str:
     return urlencode(q)
 
 
-def register_common(app: web.Application, version: str, healthy_fn=None) -> None:
+def register_common(
+    app: web.Application, version: str, healthy_fn=None, metrics=None
+) -> None:
     """/health/alive, /health/ready, /version on both ports (reference
-    healthx + version handler, registry_default.go:98-116)."""
+    healthx + version handler, registry_default.go:98-116), plus /metrics
+    (Prometheus text) when a registry is wired."""
 
     async def alive(_request):
         return web.json_response({"status": "ok"})
@@ -331,27 +421,49 @@ def register_common(app: web.Application, version: str, healthy_fn=None) -> None
     app.router.add_get("/health/ready", ready)
     app.router.add_get("/version", get_version)
 
+    if metrics is not None:
+
+        async def get_metrics(_request):
+            return web.Response(
+                text=metrics.expose(),
+                content_type="text/plain",
+                charset="utf-8",
+            )
+
+        app.router.add_get("/metrics", get_metrics)
+
 
 def build_read_app(
     manager, checker, expand_engine, snaptoken_fn, version: str,
     cors: Optional[dict] = None, healthy_fn=None, executor=None,
+    logger=None, metrics=None,
 ) -> web.Application:
-    # CORS outermost so error responses also carry the headers
+    # telemetry outermost (sees final codes), then CORS so error
+    # responses also carry the headers
     app = web.Application(
-        middlewares=[make_cors_middleware(cors), error_middleware]
+        middlewares=[
+            make_telemetry_middleware("read", logger, metrics),
+            make_cors_middleware(cors),
+            error_middleware,
+        ]
     )
     ReadAPI(manager, checker, expand_engine, snaptoken_fn, executor).register(app)
-    register_common(app, version, healthy_fn)
+    register_common(app, version, healthy_fn, metrics)
     return app
 
 
 def build_write_app(
     manager, snaptoken_fn, version: str,
     cors: Optional[dict] = None, healthy_fn=None,
+    logger=None, metrics=None,
 ) -> web.Application:
     app = web.Application(
-        middlewares=[make_cors_middleware(cors), error_middleware]
+        middlewares=[
+            make_telemetry_middleware("write", logger, metrics),
+            make_cors_middleware(cors),
+            error_middleware,
+        ]
     )
     WriteAPI(manager, snaptoken_fn).register(app)
-    register_common(app, version, healthy_fn)
+    register_common(app, version, healthy_fn, metrics)
     return app

@@ -408,3 +408,8 @@ class _DirectChecker:
 
     def check(self, request: RelationTuple, max_depth: int = 0) -> bool:
         return self.engine.subject_is_allowed(request, max_depth)
+
+    def check_batch(self, requests, max_depth: int = 0) -> list:
+        return [
+            bool(v) for v in self.engine.batch_check(requests, max_depth)
+        ]

@@ -440,6 +440,130 @@ def validate(files):
         sys.exit(1)
 
 
+@namespace.group("migrate")
+def namespace_migrate():
+    """Namespace data migrations (reference cmd/namespace/migrate_*.go)."""
+
+
+def _legacy_migrator(config_file):
+    from ..driver import Config
+    from ..persistence.legacy import SingleTableMigrator
+
+    cfg = Config(config_file=config_file)
+    dsn = cfg.dsn()
+    if not dsn.startswith("sqlite://") or dsn == "sqlite://:memory:":
+        raise click.ClickException(
+            "namespace migrate legacy requires a persistent sqlite DSN"
+        )
+    from ..persistence import SQLiteTupleStore
+
+    store = SQLiteTupleStore(
+        dsn[len("sqlite://"):], namespace_manager=cfg.namespace_manager()
+    )
+    return SingleTableMigrator(store)
+
+
+@namespace_migrate.command("legacy")
+@click.argument("namespace_name", required=False)
+@click.option("--config", "-c", "config_file", default=None, type=click.Path())
+@click.option("--yes", is_flag=True, help="skip confirmation")
+@click.option(
+    "--down-only", is_flag=True,
+    help="only drop the legacy table(s), do not copy data",
+)
+def namespace_migrate_legacy(namespace_name, config_file, yes, down_only):
+    """Migrate v0.6-layout per-namespace tables into the single-table
+    store (reference cmd/namespace/migrate_legacy.go:18-117). With no
+    namespace argument, migrates every legacy namespace found."""
+    from ..persistence.legacy import ErrInvalidTuples
+
+    migrator = _legacy_migrator(config_file)
+    if namespace_name is not None:
+        nm = migrator.namespace_manager
+        try:
+            targets = [nm.get_namespace_by_name(namespace_name)]
+        except Exception as e:
+            raise click.ClickException(
+                f"there seems to be a problem with the config: {e}"
+            )
+        if not yes:
+            click.confirm(
+                f"Are you sure you want to migrate namespace "
+                f"{namespace_name!r}?",
+                abort=True,
+            )
+    else:
+        targets = migrator.legacy_namespaces()
+        if not targets:
+            click.echo(
+                "Could not find legacy namespaces, there seems nothing "
+                "to be done."
+            )
+            return
+        listing = "".join(f"  {n.name}\n" for n in targets)
+        if not yes:
+            click.confirm(
+                f"I found the following legacy namespaces:\n{listing}"
+                "Do you want to migrate all of them?",
+                abort=True,
+            )
+    for ns in targets:
+        if not down_only:
+            try:
+                migrated, _ = migrator.migrate_namespace(ns)
+            except ErrInvalidTuples as e:
+                raise click.ClickException(
+                    f"encountered error while migrating: {e.message}\n"
+                    "Aborting. Please recreate the listed tuples manually."
+                )
+            click.echo(f"migrated {migrated} tuples from namespace {ns.name}")
+        if yes or click.confirm(
+            f"Do you want to migrate namespace {ns.name} down? This will "
+            "delete all data in the legacy table.",
+        ):
+            migrator.migrate_down(ns)
+            click.echo(f"Successfully migrated down namespace {ns.name}.")
+
+
+@namespace_migrate.command("up")
+@click.argument("namespace_name")
+def namespace_migrate_up(namespace_name):
+    """Deprecated no-op: per-namespace schema migrations no longer exist
+    (the reference deprecates this verb the same way,
+    cmd/namespace/migrate_up.go)."""
+    click.echo(
+        "deprecated: per-namespace schema migrations are no longer "
+        "necessary; see `keto namespace migrate legacy` for data migration"
+    )
+
+
+@namespace_migrate.command("down")
+@click.argument("namespace_name")
+def namespace_migrate_down(namespace_name):
+    """Deprecated no-op (reference cmd/namespace/migrate_down.go)."""
+    click.echo(
+        "deprecated: per-namespace schema migrations are no longer "
+        "necessary; see `keto namespace migrate legacy --down-only`"
+    )
+
+
+@namespace_migrate.command("status")
+@click.argument("namespace_name", required=False)
+@click.option("--config", "-c", "config_file", default=None, type=click.Path())
+def namespace_migrate_status(namespace_name, config_file):
+    """List legacy per-namespace tables still present in the database
+    (reference cmd/namespace/migrate_status.go)."""
+    migrator = _legacy_migrator(config_file)
+    found = migrator.legacy_namespaces()
+    if namespace_name is not None:
+        found = [n for n in found if n.name == namespace_name]
+    if not found:
+        click.echo("no legacy namespace tables found")
+        return
+    for ns in found:
+        click.echo(f"{ns.id}\t{ns.name}\tlegacy table present")
+
+
 # -- status / version ----------------------------------------------------------
 
 

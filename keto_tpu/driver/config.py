@@ -48,6 +48,23 @@ _CORS_SCHEMA = {
     "additionalProperties": True,
 }
 
+_TLS_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "cert": {
+            "type": "object",
+            "properties": {"path": {"type": "string"}},
+            "additionalProperties": True,
+        },
+        "key": {
+            "type": "object",
+            "properties": {"path": {"type": "string"}},
+            "additionalProperties": True,
+        },
+    },
+    "additionalProperties": True,
+}
+
 _PORT_SCHEMA = {
     "type": "object",
     "properties": {
@@ -55,6 +72,7 @@ _PORT_SCHEMA = {
         "host": {"type": "string"},
         "cors": _CORS_SCHEMA,
         "max-depth": {"type": "integer", "minimum": 1},
+        "tls": _TLS_SCHEMA,
     },
     "additionalProperties": True,
 }
@@ -62,8 +80,13 @@ _PORT_SCHEMA = {
 # The same surface as the reference's config.schema.json (380 lines there;
 # condensed here), extended with the engine subtree.
 CONFIG_SCHEMA = {
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "$id": "keto_tpu/config.schema.json",
     "type": "object",
     "properties": {
+        # version stamp accepted for compatibility with reference config
+        # files (e.g. contrib examples); not interpreted
+        "version": {"type": "string"},
         "dsn": {"type": "string"},
         "serve": {
             "type": "object",
@@ -80,7 +103,15 @@ CONFIG_SCHEMA = {
             },
             "additionalProperties": True,
         },
-        "tracing": {"type": "object"},
+        "tracing": {
+            "type": "object",
+            "properties": {
+                # "log" mirrors finished spans into the structured log;
+                # "" keeps them only in the in-process ring buffer
+                "provider": {"enum": ["", "log"]}
+            },
+            "additionalProperties": True,
+        },
         "profiling": {"type": "string"},
         "namespaces": {
             "oneOf": [
@@ -109,6 +140,7 @@ CONFIG_SCHEMA = {
                         "auto",
                         "dense",
                         "scatter",
+                        "packed",
                         "closure",
                         "sharded",
                     ]
@@ -145,6 +177,8 @@ DEFAULTS = {
     "serve.write.port": 4467,
     "serve.write.host": "",
     "log.level": "info",
+    "log.format": "text",
+    "tracing.provider": "",
     "namespaces": [],
     "engine.mode": "closure",
     "engine.dense_threshold": 8192,
@@ -180,6 +214,11 @@ def load_config_file(path: str) -> dict:
     return data
 
 
+# keys frozen after boot: a changed DSN or serve block on live reload is
+# ignored with a warning (reference provider.go:70 immutable settings)
+IMMUTABLE_KEYS = ("dsn", "serve")
+
+
 class Config:
     def __init__(
         self,
@@ -194,10 +233,89 @@ class Config:
         if values:
             data = _deep_merge(data, values)
         self._data = data
+        self.config_file = config_file
+        self._values = dict(values or {})
         self._env = dict(env if env is not None else os.environ)
         self._overrides: dict[str, Any] = dict(flag_overrides or {})
         self.validate()
         self._namespace_manager: Optional[NamespaceManager] = None
+
+    def reload(self) -> list[str]:
+        """Re-read the config file (hot reload, reference provider.go:58-104).
+
+        Returns the list of changed top-level keys that were APPLIED.
+        Immutable keys (DSN, serve) keep their boot values; a changed
+        ``namespaces`` spec rebuilds/refreshes the namespace manager in
+        place so stores holding a reference see the new set. Raises
+        ErrMalformedInput when the new file fails schema validation — the
+        previous config keeps serving (rollback-to-last-good)."""
+        if not self.config_file:
+            return []
+        fresh = load_config_file(self.config_file)
+        if self._values:
+            fresh = _deep_merge(fresh, self._values)
+        try:
+            jsonschema.validate(fresh, CONFIG_SCHEMA)
+        except jsonschema.ValidationError as e:
+            raise ErrMalformedInput(
+                f"invalid configuration: {e.message} "
+                f"(at {'/'.join(map(str, e.path))})"
+            ) from e
+        old = self._data
+        changed = [
+            k
+            for k in set(old) | set(fresh)
+            if old.get(k) != fresh.get(k)
+        ]
+        applied = []
+        for key in changed:
+            if key in IMMUTABLE_KEYS:
+                continue  # frozen after boot
+            applied.append(key)
+        merged = dict(fresh)
+        for key in IMMUTABLE_KEYS:
+            if key in old:
+                merged[key] = old[key]
+            else:
+                merged.pop(key, None)
+        self._data = merged
+        if "namespaces" in applied:
+            self._refresh_namespace_manager()
+        return sorted(applied)
+
+    def _refresh_namespace_manager(self) -> None:
+        wrapper = self._namespace_manager
+        if wrapper is None:
+            return  # nothing built yet; next namespace_manager() call reads fresh
+        inner = wrapper.inner
+        spec = self.get(KEY_NAMESPACES)
+        from ..namespace.watcher import NamespaceWatcher
+
+        if isinstance(inner, MemoryNamespaceManager) and isinstance(
+            spec, list
+        ):
+            inner.replace_all(
+                [
+                    Namespace(
+                        name=n["name"],
+                        id=int(n.get("id", 0)),
+                        config=n.get("config", {}) or {},
+                    )
+                    for n in spec
+                ]
+            )
+        elif (
+            isinstance(inner, NamespaceWatcher)
+            and isinstance(spec, str)
+            and _uri_path(spec) == inner.path
+        ):
+            pass  # same URI: the watcher's own poll loop handles content
+        else:
+            # inline <-> URI flip (or new URI): swap the wrapped manager;
+            # stores hold the stable wrapper, so they see the new set
+            if hasattr(inner, "close"):
+                inner.close()
+            wrapper.inner = self._build_namespace_manager()
 
     def validate(self) -> None:
         try:
@@ -258,24 +376,59 @@ class Config:
 
     def namespace_manager(self) -> NamespaceManager:
         """Inline array -> memory manager; string URI -> file/dir watcher with
-        hot reload (reference provider.go:190-218 dispatch)."""
+        hot reload (reference provider.go:190-218 dispatch). Returned behind
+        a stable delegating wrapper so config hot-reload can swap the
+        underlying manager without invalidating store references."""
         if self._namespace_manager is None:
-            spec = self.get(KEY_NAMESPACES)
-            if isinstance(spec, str):
-                from ..namespace.watcher import NamespaceWatcher
-
-                self._namespace_manager = NamespaceWatcher(spec)
-            else:
-                nss = [
-                    Namespace(
-                        name=n["name"],
-                        id=int(n.get("id", 0)),
-                        config=n.get("config", {}) or {},
-                    )
-                    for n in (spec or [])
-                ]
-                self._namespace_manager = MemoryNamespaceManager(*nss)
+            self._namespace_manager = _SwappableNamespaceManager(
+                self._build_namespace_manager()
+            )
         return self._namespace_manager
+
+    def _build_namespace_manager(self) -> NamespaceManager:
+        spec = self.get(KEY_NAMESPACES)
+        if isinstance(spec, str):
+            from ..namespace.watcher import NamespaceWatcher
+
+            return NamespaceWatcher(spec)
+        nss = [
+            Namespace(
+                name=n["name"],
+                id=int(n.get("id", 0)),
+                config=n.get("config", {}) or {},
+            )
+            for n in (spec or [])
+        ]
+        return MemoryNamespaceManager(*nss)
+
+
+def _uri_path(uri: str) -> str:
+    from urllib.parse import urlparse
+
+    if uri.startswith("file://"):
+        return urlparse(uri).path
+    return uri
+
+
+class _SwappableNamespaceManager(NamespaceManager):
+    """Stable handle over a replaceable NamespaceManager (config hot-reload
+    swaps `inner`; stores and engines keep this wrapper)."""
+
+    def __init__(self, inner: NamespaceManager):
+        self.inner = inner
+
+    def get_namespace_by_name(self, name: str):
+        return self.inner.get_namespace_by_name(name)
+
+    def namespaces(self):
+        return self.inner.namespaces()
+
+    def should_reload(self, page_payload=None) -> bool:
+        return self.inner.should_reload(page_payload)
+
+    def close(self) -> None:
+        if hasattr(self.inner, "close"):
+            self.inner.close()
 
 
 def _deep_merge(base: dict, extra: dict) -> dict:

@@ -10,6 +10,8 @@ daemon.go:62-69 ServeAll).
 from __future__ import annotations
 
 import asyncio
+import os
+import threading
 from typing import Optional
 
 from .. import __version__
@@ -45,6 +47,66 @@ class Registry:
         self._read_plane: Optional[PlaneServer] = None
         self._write_plane: Optional[PlaneServer] = None
         self._check_executor = None
+        self._logger = None
+        self._tracer = None
+        self._metrics = None
+        self._config_watcher: Optional[threading.Thread] = None
+        self._config_watch_stop = threading.Event()
+
+    # -- observability providers (reference registry_default.go:118-136) ------
+
+    def logger(self):
+        if self._logger is None:
+            from ..telemetry import configure_logging, get_logger
+
+            configure_logging(
+                level=str(self.config.get("log.level")),
+                format=str(self.config.get("log.format", default="text")),
+            )
+            self._logger = get_logger("server")
+        return self._logger
+
+    def tracer(self):
+        if self._tracer is None:
+            from ..telemetry import Tracer
+
+            provider = str(
+                self.config.get("tracing.provider", default="") or ""
+            )
+            self._tracer = Tracer(provider=provider, logger=self.logger())
+        return self._tracer
+
+    def metrics(self):
+        if self._metrics is None:
+            from ..telemetry import MetricsRegistry
+
+            m = MetricsRegistry()
+            store = self.store()
+            m.gauge(
+                "keto_store_version",
+                "monotonic store write version (the snaptoken)",
+                fn=lambda: store.version,
+            )
+            m.gauge(
+                "keto_store_tuples",
+                "live relation tuples in the store",
+                fn=lambda: len(store),
+            )
+            m.gauge(
+                "keto_check_staleness_versions",
+                "store versions the check engine lags behind (bounded "
+                "freshness rebuilds in progress)",
+                fn=self._staleness,
+            )
+            self._metrics = m
+        return self._metrics
+
+    def _staleness(self) -> int:
+        engine = self._check_engine
+        served = getattr(engine, "served_version", None)
+        if served is None:
+            return 0
+        return max(0, self.store().version - served())
 
     # -- providers (lazy, like RegistryDefault's memoized getters) ------------
 
@@ -117,6 +179,9 @@ class Registry:
                         self.config.get("engine.rebuild_debounce_ms")
                     )
                     / 1e3,
+                    tracer=self.tracer(),
+                    metrics=self.metrics(),
+                    logger=self.logger(),
                 )
             elif mode == "sharded":
                 from ..parallel import ShardedCheckEngine, make_mesh
@@ -129,12 +194,14 @@ class Registry:
                     max_depth=max_depth,
                 )
             else:
-                # 'device' -> size-based propagation choice;
-                # 'dense'/'scatter' force that propagation path
+                # 'device' -> size-based propagation choice; 'dense'/
+                # 'scatter'/'packed' force that propagation path
                 self._check_engine = DeviceCheckEngine(
                     self.snapshots(),
                     max_depth=max_depth,
-                    mode=mode if mode in ("dense", "scatter") else "auto",
+                    mode=mode
+                    if mode in ("dense", "scatter", "packed")
+                    else "auto",
                     dense_threshold=int(
                         self.config.get("engine.dense_threshold")
                     ),
@@ -170,6 +237,7 @@ class Registry:
                     max_batch=int(self.config.get("engine.max_batch")),
                     window_s=float(self.config.get("engine.batch_window_us"))
                     / 1e6,
+                    metrics=self.metrics(),
                 )
                 self._checker = self._batcher
         return self._checker
@@ -193,8 +261,12 @@ class Registry:
     def _grpc_workers(self) -> int:
         # every in-flight check blocks a worker; size the pools so a device
         # batch can actually fill (capped: threads blocked on futures are
-        # cheap but not free)
-        return min(int(self.config.get("engine.max_batch")), 512)
+        # cheap but not free, and on small hosts hundreds of runnable
+        # threads just thrash the scheduler)
+        import os
+
+        cap = max(64, 32 * (os.cpu_count() or 1))
+        return min(int(self.config.get("engine.max_batch")), cap, 512)
 
     def check_executor(self):
         if self._check_executor is None:
@@ -216,6 +288,9 @@ class Registry:
                 self.version,
                 self.health,
                 max_workers=self._grpc_workers(),
+                logger=self.logger(),
+                metrics=self.metrics(),
+                tracer=self.tracer(),
             )
             app = build_read_app(
                 self.store(),
@@ -226,19 +301,28 @@ class Registry:
                 cors=self.config.cors("read"),
                 healthy_fn=self.health.is_serving,
                 executor=self.check_executor(),
+                logger=self.logger(),
+                metrics=self.metrics(),
             )
             self._read_plane = PlaneServer(
                 grpc_server,
                 app,
                 host=self.config.read_api_host(),
                 port=self.config.read_api_port(),
+                ssl_context=self._ssl_context("read"),
             )
         return self._read_plane
 
     def write_plane(self) -> PlaneServer:
         if self._write_plane is None:
             grpc_server = build_write_grpc_server(
-                self.store(), self.snaptoken, self.version, self.health
+                self.store(),
+                self.snaptoken,
+                self.version,
+                self.health,
+                logger=self.logger(),
+                metrics=self.metrics(),
+                tracer=self.tracer(),
             )
             app = build_write_app(
                 self.store(),
@@ -246,14 +330,32 @@ class Registry:
                 self.version,
                 cors=self.config.cors("write"),
                 healthy_fn=self.health.is_serving,
+                logger=self.logger(),
+                metrics=self.metrics(),
             )
             self._write_plane = PlaneServer(
                 grpc_server,
                 app,
                 host=self.config.write_api_host(),
                 port=self.config.write_api_port(),
+                ssl_context=self._ssl_context("write"),
             )
         return self._write_plane
+
+    def _ssl_context(self, plane: str):
+        """TLS termination at the muxed port when serve.<plane>.tls.* is
+        configured (reference serves TLS per the same schema keys)."""
+        cert = self.config.get(f"serve.{plane}.tls.cert.path", default=None)
+        key = self.config.get(f"serve.{plane}.tls.key.path", default=None)
+        if not cert or not key:
+            return None
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        # gRPC clients negotiate h2 via ALPN; advertise both protocols
+        ctx.set_alpn_protocols(["h2", "http/1.1"])
+        return ctx
 
     async def start_all(self) -> tuple[int, int]:
         """Start both planes; returns (read_port, write_port). Pre-warms the
@@ -262,20 +364,89 @@ class Registry:
         buckets) so live traffic rarely pays an XLA compile — shapes that
         also depend on a batch's fan-out widths can still compile on first
         live hit."""
+        log = self.logger()
         engine = self.check_engine()
         if hasattr(engine, "warmup"):
             max_batch = int(self.config.get("engine.max_batch"))
+            log.info(
+                "warmup", engine=type(engine).__name__, max_batch=max_batch
+            )
             await asyncio.get_running_loop().run_in_executor(
                 None, lambda: engine.warmup(max_batch)
             )
         read_port = await self.read_plane().start()
         write_port = await self.write_plane().start()
+        self._start_config_watcher()
         self.health.set_serving(True)  # readiness flips only after bring-up
+        log.info(
+            "serving",
+            read_port=read_port,
+            write_port=write_port,
+            engine=type(engine).__name__,
+            dsn=self.config.dsn(),
+        )
         return read_port, write_port
+
+    def _start_config_watcher(self, poll_interval_s: float = 1.0) -> None:
+        """Hot-reload the config FILE while serving (reference
+        provider.go:58-104): mutable keys (namespaces, log, tracing) apply
+        live; DSN/serve stay frozen; a file that fails validation keeps the
+        previous config serving."""
+        if not self.config.config_file or self._config_watcher is not None:
+            return
+        path = self.config.config_file
+        log = self.logger()
+
+        def watch():
+            try:
+                last = os.stat(path).st_mtime
+            except OSError:
+                last = 0.0
+            while not self._config_watch_stop.wait(poll_interval_s):
+                try:
+                    mtime = os.stat(path).st_mtime
+                except OSError:
+                    continue
+                if mtime == last:
+                    continue
+                last = mtime
+                try:
+                    applied = self.config.reload()
+                except Exception as e:
+                    log.warn(
+                        "config reload failed; keeping previous config",
+                        error=str(e),
+                    )
+                    continue
+                if applied:
+                    log.info("config reloaded", changed=applied)
+                    if "log" in applied:
+                        from ..telemetry import configure_logging
+
+                        configure_logging(
+                            level=str(self.config.get("log.level")),
+                            format=str(
+                                self.config.get("log.format", default="text")
+                            ),
+                        )
+                    if "tracing" in applied and self._tracer is not None:
+                        self._tracer.provider = str(
+                            self.config.get("tracing.provider", default="")
+                            or ""
+                        )
+
+        self._config_watcher = threading.Thread(
+            target=watch, name="config-watcher", daemon=True
+        )
+        self._config_watcher.start()
 
     async def stop_all(self) -> None:
         # flip readiness first so load balancers stop routing here
         self.health.set_serving(False)
+        if self._config_watcher is not None:
+            self._config_watch_stop.set()
+            self._config_watcher.join(timeout=5)
+            self._config_watcher = None
         if self._read_plane is not None:
             await self._read_plane.stop()
         if self._write_plane is not None:

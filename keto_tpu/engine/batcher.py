@@ -25,10 +25,20 @@ class CheckBatcher:
         engine,  # anything with batch_check(requests, depths=...) -> list[bool]
         max_batch: int = 4096,
         window_s: float = 0.0002,
+        metrics=None,
     ):
         self.engine = engine
         self.max_batch = max_batch
         self.window_s = window_s
+        self._m_batch_size = (
+            metrics.histogram(
+                "keto_batcher_batch_size",
+                "requests coalesced per dispatched batch",
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+            )
+            if metrics is not None
+            else None
+        )
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: list[tuple[RelationTuple, int, Future]] = []
@@ -48,6 +58,15 @@ class CheckBatcher:
             self._queue.append((request, max_depth, f))
             self._cv.notify()
         return f.result(timeout=timeout)
+
+    def check_batch(
+        self, requests: Sequence[RelationTuple], max_depth: int = 0
+    ) -> list[bool]:
+        """A caller-assembled batch: already amortized, so it skips the
+        queue and dispatches directly (the batch-check transport path)."""
+        return [
+            bool(v) for v in self.engine.batch_check(requests, max_depth)
+        ]
 
     def close(self) -> None:
         with self._cv:
@@ -78,6 +97,8 @@ class CheckBatcher:
                 batch = self._drain()
             if not batch:
                 continue
+            if self._m_batch_size is not None:
+                self._m_batch_size.observe(len(batch))
             requests = [b[0] for b in batch]
             depths = [b[1] for b in batch]
             try:

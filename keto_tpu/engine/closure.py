@@ -80,6 +80,10 @@ _MAX_CLOSURE_DEPTH = INF_DIST
 # edge; past this many new edges the O(M^3) full rebuild wins back
 _MAX_INCR_EDGES = 8
 
+# rows whose F0 and L fan-outs both fit this width take the narrow gather
+# path; the heavy tail is processed separately at full width
+_NARROW_WIDTH = 8
+
 
 def _bucket_pow2(n: int, minimum: int = _MIN_BATCH) -> int:
     return _bucket(n, minimum)
@@ -173,6 +177,9 @@ class ClosureCheckEngine:
         strong_freshness_edges: int = 1 << 21,
         rebuild_debounce_s: float = 0.05,
         fallback=None,
+        tracer=None,
+        metrics=None,
+        logger=None,
     ):
         self.snapshots = snapshots
         self.global_max_depth = max_depth
@@ -198,6 +205,24 @@ class ClosureCheckEngine:
         # build telemetry (read by tests and the metrics endpoint)
         self.n_full_builds = 0
         self.n_incremental_builds = 0
+        from ..telemetry.tracing import NOOP_TRACER
+
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
+        self.logger = logger
+        if metrics is not None:
+            self._m_checks = metrics.counter(
+                "keto_checks_total", "checks evaluated by the engine"
+            )
+            self._m_batch_s = metrics.histogram(
+                "keto_check_batch_seconds", "engine batch evaluation time"
+            )
+            self._m_builds = metrics.counter(
+                "keto_closure_builds_total",
+                "closure builds by kind",
+                labelnames=("kind",),
+            )
+        else:
+            self._m_checks = self._m_batch_s = self._m_builds = None
 
     # -- residency ------------------------------------------------------------
 
@@ -252,7 +277,8 @@ class ClosureCheckEngine:
                 and state.version == self.snapshots.store.version
             ):
                 return state  # a concurrent builder got there first
-            snap = self.snapshots.snapshot()
+            with self.tracer.span("snapshot.encode"):
+                snap = self.snapshots.snapshot()
             state = self._build_state(snap, prev=self._state)
             self._state = state
             return state
@@ -287,24 +313,46 @@ class ClosureCheckEngine:
     def _build_state(
         self, snap: GraphSnapshot, prev: Optional[_State]
     ) -> _State:
-        ig = build_interior(snap)
-        if ig.m > self.interior_limit or (
-            self.global_max_depth > _MAX_CLOSURE_DEPTH
-        ):
-            # depths beyond the uint8 distance range cannot be resolved
-            # by the closure — exact fallback for the whole snapshot
-            return _TooBig(version=snap.version, num_edges=snap.num_edges)
-        k_max = self.global_max_depth - 1
-        host = self.host_queries()
-        if isinstance(prev, _ClosureArtifacts):
-            new_ii = self._appended_interior_edges(prev, snap, ig)
-            if new_ii is not None and len(new_ii) <= _MAX_INCR_EDGES:
-                self.n_incremental_builds += 1
-                return self._incremental_artifacts(
-                    prev, snap, ig, k_max, host, new_ii
+        with self.tracer.span(
+            "closure.build", edges=snap.num_edges, version=snap.version
+        ) as span:
+            with self.tracer.span("closure.interior"):
+                ig = build_interior(snap)
+            span.set_attr("interior", ig.m)
+            if ig.m > self.interior_limit or (
+                self.global_max_depth > _MAX_CLOSURE_DEPTH
+            ):
+                # depths beyond the uint8 distance range cannot be resolved
+                # by the closure — exact fallback for the whole snapshot
+                span.set_attr("kind", "fallback")
+                if self.logger is not None:
+                    self.logger.warn(
+                        "interior exceeds closure limit; serving from the "
+                        "exact fallback engine",
+                        interior=ig.m,
+                        limit=self.interior_limit,
+                    )
+                return _TooBig(
+                    version=snap.version, num_edges=snap.num_edges
                 )
-        self.n_full_builds += 1
-        return _ClosureArtifacts(snap, ig, k_max, host)
+            k_max = self.global_max_depth - 1
+            host = self.host_queries()
+            if isinstance(prev, _ClosureArtifacts):
+                new_ii = self._appended_interior_edges(prev, snap, ig)
+                if new_ii is not None and len(new_ii) <= _MAX_INCR_EDGES:
+                    self.n_incremental_builds += 1
+                    span.set_attr("kind", "incremental")
+                    if self._m_builds is not None:
+                        self._m_builds.labels(kind="incremental").inc()
+                    return self._incremental_artifacts(
+                        prev, snap, ig, k_max, host, new_ii
+                    )
+            self.n_full_builds += 1
+            span.set_attr("kind", "full")
+            if self._m_builds is not None:
+                self._m_builds.labels(kind="full").inc()
+            with self.tracer.span("closure.matmul", interior=ig.m):
+                return _ClosureArtifacts(snap, ig, k_max, host)
 
     @staticmethod
     def _appended_interior_edges(
@@ -394,6 +442,7 @@ class ClosureCheckEngine:
     ) -> list[bool]:
         if not requests:
             return []
+        t0 = time.perf_counter()
         state = self._serving()
         if not isinstance(state, _ClosureArtifacts):
             # interior too large for a closure: exact fallback
@@ -445,6 +494,9 @@ class ClosureCheckEngine:
         allowed = self._check_arrays(
             snap, art, start, target, is_id, depth, requests
         )
+        if self._m_checks is not None:
+            self._m_checks.inc(n)
+            self._m_batch_s.observe(time.perf_counter() - t0)
         return allowed.tolist()
 
     def check_ids(
@@ -534,12 +586,65 @@ class ClosureCheckEngine:
         ig = art.ig
         direct = ig.direct_edge(start, target)
 
-        # adaptive row widths: pad to this batch's max degree (pow2-bucketed
-        # for jit-shape stability), capped at f0_max/l_max — typical batches
-        # gather [B, 4, 16] instead of [B, 32, 32]
-        f0_w = self._adaptive_width(
-            ig.set_out_indptr, start, self.f0_max
+        # split by fan-out: one hot row (a user in 30 groups) would
+        # otherwise widen the WHOLE batch's D gather to [B, 32, 32]; the
+        # narrow majority gathers [*, <=8, <=8] — ~16x less random traffic
+        # into the closure matrix — while only the heavy tail pays full
+        # width
+        f0_deg = (
+            ig.set_out_indptr[start + 1] - ig.set_out_indptr[start]
         )
+        l_deg = np.where(
+            is_id,
+            ig.id_in_indptr[target + 1] - ig.id_in_indptr[target],
+            1,  # set targets: L = {target}
+        )
+        narrow = (f0_deg <= _NARROW_WIDTH) & (l_deg <= _NARROW_WIDTH)
+        allowed = np.zeros(n, dtype=bool)
+        overflow = np.zeros(n, dtype=bool)
+        if narrow.all() or not narrow.any():
+            parts = [np.arange(n)]
+        else:
+            parts = [np.nonzero(narrow)[0], np.nonzero(~narrow)[0]]
+        for idx in parts:
+            a, ov = self._query_rows(
+                art,
+                ig,
+                start[idx],
+                target[idx],
+                is_id[idx],
+                depth[idx],
+                direct[idx],
+            )
+            allowed[idx] = a
+            overflow[idx] = ov
+
+        # ---- exact fallback for overflowing rows (wide F0/L fan-out)
+        if overflow.any():
+            fb = self.fallback_engine()
+            idxs = np.nonzero(overflow)[0]
+            if requests is not None:
+                over_reqs = [requests[i] for i in idxs]
+            else:
+                over_reqs = self._decode_requests(
+                    snap, start[idxs], target[idxs]
+                )
+            res = fb.batch_check(
+                over_reqs, depths=[int(depth[i]) for i in idxs]
+            )
+            for i, v in zip(idxs, res):
+                allowed[i] = v
+        return allowed
+
+    def _query_rows(
+        self, art, ig, start, target, is_id, depth, direct
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Gather + closure query for one fan-out class of rows. Returns
+        (allowed, overflow) for the subset."""
+        n = len(start)
+        # adaptive row widths: pad to this subset's max degree
+        # (pow2-bucketed for jit-shape stability), capped at f0_max/l_max
+        f0_w = self._adaptive_width(ig.set_out_indptr, start, self.f0_max)
         l_w = self._adaptive_width(ig.id_in_indptr, target, self.l_max)
         f0, f0_over = gather_padded_rows(
             ig.set_out_indptr, ig.set_out_vals, start, f0_w, art.pad
@@ -558,26 +663,8 @@ class ClosureCheckEngine:
         l_over &= is_id  # set-target rows never overflow
 
         extra = is_id.astype(np.int32)
-
         allowed = self._query(art, f0, l, extra, depth, direct, n)
-
-        # ---- exact fallback for overflowing rows (wide F0/L fan-out)
-        overflow = f0_over | l_over
-        if overflow.any():
-            fb = self.fallback_engine()
-            idxs = np.nonzero(overflow)[0]
-            if requests is not None:
-                over_reqs = [requests[i] for i in idxs]
-            else:
-                over_reqs = self._decode_requests(
-                    snap, start[idxs], target[idxs]
-                )
-            res = fb.batch_check(
-                over_reqs, depths=[int(depth[i]) for i in idxs]
-            )
-            for i, v in zip(idxs, res):
-                allowed[i] = v
-        return allowed
+        return allowed, f0_over | l_over
 
     @staticmethod
     def _adaptive_width(indptr, rows, cap: int) -> int:

@@ -43,6 +43,7 @@ from .tree import Tree, NodeType
 
 _MIN_BATCH = 8
 _DENSE_THRESHOLD_DEFAULT = 8192  # adj = bf16 N*N: 8192^2 = 128 MiB in HBM
+_PACKED_MIN_BATCH = 4096  # bitpacked kernel: W = B/32 int32 lanes, W % 128 == 0
 
 
 def _bucket_batch(b: int) -> int:
@@ -50,23 +51,36 @@ def _bucket_batch(b: int) -> int:
 
 
 class _DeviceGraph:
-    """Per-snapshot device residency: uploaded COO arrays or dense adjacency."""
+    """Per-snapshot device residency: uploaded COO arrays, dense adjacency,
+    or dst-sorted edges for the bitpacked DMA kernel (``packed`` mode)."""
 
-    def __init__(self, snap: GraphSnapshot, dense: bool):
+    def __init__(self, snap: GraphSnapshot, mode: str):
         self.host_src = snap.src  # identity keys for the residency cache:
         self.host_dst = snap.dst  # equal arrays => equal device contents
         self.padded_nodes = snap.padded_nodes
         self.padded_edges = snap.padded_edges
-        self.dense = dense
-        if dense:
+        self.mode = mode
+        self.adj = self.src = self.dst = None
+        self.src_by_dst = self.dst_by_dst = None
+        if mode == "dense":
             self.adj = build_dense_adjacency(
                 jnp.asarray(snap.src), jnp.asarray(snap.dst), snap.padded_nodes
             )
-            self.src = self.dst = None
+        elif mode == "packed":
+            # the DMA kernel streams edges in in-CSR (dst-sorted) order so
+            # destination windows flush once; padding edges (dummy->dummy)
+            # sort to the tail, which is harmless — the dummy row is inert
+            e = snap.num_edges
+            order = np.argsort(snap.dst[:e], kind="stable")
+            self.src_by_dst = jnp.asarray(snap.src[:e][order])
+            self.dst_by_dst = jnp.asarray(snap.dst[:e][order])
         else:
-            self.adj = None
             self.src = jnp.asarray(snap.src)
             self.dst = jnp.asarray(snap.dst)
+
+    @property
+    def dense(self) -> bool:
+        return self.mode == "dense"
 
 
 class DeviceCheckEngine:
@@ -74,8 +88,9 @@ class DeviceCheckEngine:
         self,
         snapshots: SnapshotManager,
         max_depth: int = DEFAULT_MAX_DEPTH,
-        mode: str = "auto",  # auto | dense | scatter
+        mode: str = "auto",  # auto | dense | scatter | packed
         dense_threshold: int = _DENSE_THRESHOLD_DEFAULT,
+        interpret: Optional[bool] = None,
     ):
         self.snapshots = snapshots
         self.global_max_depth = max_depth
@@ -83,6 +98,14 @@ class DeviceCheckEngine:
         self.dense_threshold = dense_threshold
         self._lock = threading.Lock()
         self._cached: Optional[_DeviceGraph] = None
+        self._scatter_companion: Optional[_DeviceGraph] = None
+        if interpret is None:
+            # the packed kernel is Mosaic/TPU; elsewhere (CPU test meshes)
+            # it runs in pallas interpret mode
+            import jax
+
+            interpret = jax.default_backend() not in ("tpu", "axon")
+        self.interpret = interpret
 
     # -- device residency ----------------------------------------------------
 
@@ -98,13 +121,15 @@ class DeviceCheckEngine:
                 and cached.host_dst is snap.dst
             ):
                 return cached
-            if self.mode == "dense":
-                dense = True
-            elif self.mode == "scatter":
-                dense = False
+            if self.mode in ("dense", "scatter", "packed"):
+                mode = self.mode
             else:
-                dense = snap.padded_nodes <= self.dense_threshold
-            dg = _DeviceGraph(snap, dense)
+                mode = (
+                    "dense"
+                    if snap.padded_nodes <= self.dense_threshold
+                    else "scatter"
+                )
+            dg = _DeviceGraph(snap, mode)
             self._cached = dg
             return dg
 
@@ -142,7 +167,11 @@ class DeviceCheckEngine:
         snap = self.snapshots.snapshot()
         dg = self._device_graph(snap)
         n = len(requests)
-        b = _bucket_batch(n)
+        b = (
+            _PACKED_MIN_BATCH * ((n + _PACKED_MIN_BATCH - 1) // _PACKED_MIN_BATCH)
+            if dg.mode == "packed"  # W = B/32 lanes must fill 128-lane tiles
+            else _bucket_batch(n)
+        )
         dummy = snap.dummy_node
         start = np.full(b, dummy, dtype=np.int32)
         target = np.full(b, dummy, dtype=np.int32)
@@ -152,7 +181,26 @@ class DeviceCheckEngine:
             target[i] = snap.node_for_subject(r.subject)
             want = depths[i] if depths is not None else max_depth
             depth[i] = clamp_depth(want, self.global_max_depth)
-        if dg.dense:
+        if dg.mode == "packed":
+            from ..ops.packed import packed_batched_check
+
+            # unknown-node contract: a dummy start must not "reach" the
+            # dummy target through the shared dummy row — force depth 0
+            depth[:n] = np.where(
+                (start[:n] == dummy) | (target[:n] == dummy), 0, depth[:n]
+            )
+            depth[n:] = 0
+            hit = packed_batched_check(
+                dg.src_by_dst,
+                dg.dst_by_dst,
+                jnp.asarray(start),
+                jnp.asarray(target),
+                jnp.asarray(depth),
+                padded_nodes=dg.padded_nodes,
+                max_steps=self.global_max_depth,
+                interpret=self.interpret,
+            )
+        elif dg.dense:
             hit = batched_check_dense(
                 dg.adj,
                 jnp.asarray(start),
@@ -189,6 +237,19 @@ class DeviceCheckEngine:
             start[i] = snap.node_for_set(s.namespace, s.object, s.relation)
         d = clamp_depth(max_depth, self.global_max_depth)
         depth = np.full(b, d, dtype=np.int32)
+        if dg.mode == "packed":
+            # distances are an expand-support query, not the packed check's
+            # hot path: reuse the COO scatter kernel — cached per snapshot
+            # (a fresh upload per expand would re-ship the whole edge list)
+            companion = self._scatter_companion
+            if not (
+                companion is not None
+                and companion.host_src is snap.src
+                and companion.host_dst is snap.dst
+            ):
+                companion = _DeviceGraph(snap, "scatter")
+                self._scatter_companion = companion
+            dg = companion
         if dg.dense:
             dist = batched_distances_dense(
                 dg.adj,

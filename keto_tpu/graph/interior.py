@@ -60,32 +60,86 @@ class InteriorGraph:
     # sources; values are interior indices of src. Feeds L(target).
     id_in_indptr: np.ndarray  # int32[padded_nodes + 1]
     id_in_vals: np.ndarray  # int32[e_id_interior]
-    # sorted int64 keys src * padded_nodes + dst of every live edge, for the
-    # vectorized direct-edge membership test
-    edge_keys: np.ndarray
+    # open-addressing hash set of int64 keys src * padded_nodes + dst for
+    # the vectorized direct-edge membership test: ~1.3 probes per lookup
+    # at 0.6 load vs ~27 cache-missing rounds for binary search over a
+    # 100M-key sorted array
+    edge_table: np.ndarray  # int64[2^k], -1 = empty
+    edge_mask: int
 
     def direct_edge(self, src_ids: np.ndarray, dst_ids: np.ndarray) -> np.ndarray:
-        """bool[n]: does the edge (src, dst) exist? Vectorized searchsorted."""
+        """bool[n]: does the edge (src, dst) exist? Vectorized hash probe."""
         keys = src_ids.astype(np.int64) * self.padded_nodes + dst_ids.astype(
             np.int64
         )
-        pos = np.searchsorted(self.edge_keys, keys)
-        in_range = pos < len(self.edge_keys)
-        hit = np.zeros(len(keys), dtype=bool)
-        if len(self.edge_keys):
-            hit[in_range] = self.edge_keys[pos[in_range]] == keys[in_range]
-        return hit
+        return _hash_contains(self.edge_table, self.edge_mask, keys)
+
+
+def _mix(keys: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer, vectorized (uint64 wraparound is the point)."""
+    with np.errstate(over="ignore"):
+        x = keys.astype(np.uint64)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
+def _build_edge_hash(keys: np.ndarray) -> tuple[np.ndarray, int]:
+    """(table int64[2^k], mask): open-addressing set of `keys` (>= 0;
+    duplicates fine) at <= 0.6 load, built with vectorized probe rounds."""
+    n = max(len(keys), 1)
+    # next pow2 >= n/0.6: load stays <= 0.6, so an empty slot always
+    # terminates a miss's probe chain
+    size = 1 << int(n / 0.6).bit_length()
+    mask = size - 1
+    table = np.full(size, -1, dtype=np.int64)
+    if len(keys) == 0:
+        return table, mask
+    k = keys.astype(np.int64)
+    idx = (_mix(k) & np.uint64(mask)).astype(np.int64)
+    pending = np.arange(len(k), dtype=np.int64)
+    while len(pending):
+        slots = idx[pending]
+        occ = table[slots]
+        placeable = (occ == -1) | (occ == k[pending])
+        # concurrent writers to one slot: numpy keeps the last — verify
+        # placement below and linear-probe the losers onward
+        table[slots[placeable]] = k[pending[placeable]]
+        placed = table[idx[pending]] == k[pending]
+        pending = pending[~placed]
+        idx[pending] = (idx[pending] + 1) & mask
+    return table, mask
+
+
+def _hash_contains(
+    table: np.ndarray, mask: int, keys: np.ndarray
+) -> np.ndarray:
+    k = keys.astype(np.int64)
+    idx = (_mix(k) & np.uint64(mask)).astype(np.int64)
+    out = np.zeros(len(k), dtype=bool)
+    active = np.arange(len(k), dtype=np.int64)
+    while len(active):
+        v = table[idx[active]]
+        hit = v == k[active]
+        out[active[hit]] = True
+        cont = ~hit & (v != -1)  # empty slot ends the probe chain
+        active = active[cont]
+        idx[active] = (idx[active] + 1) & mask
+    return out
 
 
 def _csr_by(
     group: np.ndarray, vals: np.ndarray, n_groups: int
 ) -> tuple[np.ndarray, np.ndarray]:
-    """(indptr int32[n_groups+1], vals sorted by group) via stable argsort."""
+    """(indptr int32[n_groups+1], vals sorted by group) via stable argsort.
+    int32 offsets (edge counts stay < 2^31): at 100M-tuple scale the indptr
+    arrays span tens of millions of nodes and live in the query hot path —
+    half the bytes, half the cache misses."""
     order = np.argsort(group, kind="stable")
     counts = np.bincount(group, minlength=n_groups)
     indptr = np.zeros(n_groups + 1, dtype=np.int64)
     np.cumsum(counts, out=indptr[1:])
-    return indptr.astype(np.int64), vals[order]
+    return indptr.astype(np.int32), vals[order]
 
 
 def build_interior(snap: GraphSnapshot) -> InteriorGraph:
@@ -128,7 +182,9 @@ def build_interior(snap: GraphSnapshot) -> InteriorGraph:
     keep_l = i_src_idx >= 0
     id_in_indptr, id_in_vals = _csr_by(i_dst[keep_l], i_src_idx[keep_l], pn)
 
-    edge_keys = np.sort(src.astype(np.int64) * pn + dst.astype(np.int64))
+    edge_table, edge_mask = _build_edge_hash(
+        src.astype(np.int64) * pn + dst.astype(np.int64)
+    )
 
     return InteriorGraph(
         padded_nodes=pn,
@@ -141,7 +197,8 @@ def build_interior(snap: GraphSnapshot) -> InteriorGraph:
         set_out_vals=set_out_vals.astype(np.int32),
         id_in_indptr=id_in_indptr,
         id_in_vals=id_in_vals.astype(np.int32),
-        edge_keys=edge_keys,
+        edge_table=edge_table,
+        edge_mask=edge_mask,
     )
 
 

@@ -22,12 +22,17 @@ explicit DMA streaming:
   windows the kernel never visits — nodes with no in-edges — correctly stay
   empty frontiers.
 
-The surrounding check loop (jitted) matches frontier.py semantics: depth
-clamping per request, hit at step i+1 iff i < depth[b], early exit when all
-requests are done. Cycles terminate because reachability is monotone and the
-loop is depth-bounded. Unknown start/target nodes are handled by the engine
-forcing depth 0 (the dummy row would otherwise let an unknown start "reach"
-an unknown target).
+The surrounding check loop (jitted) matches frontier.py semantics with one
+structural difference: probe edges read the frontier BEFORE the pass's
+propagation (they ride the same edge stream), so the probe lags one
+iteration. The loop compensates by (a) replacing the frontier with the
+propagated set after iteration 0 — dropping the start bit, so from then on
+the frontier holds exactly the nodes at distance in [1, i] and a
+start==target request cannot trivially "reach" itself — and (b) running
+depth+1 probe iterations with hit condition ``1 <= i <= depth[b]``. Cycles
+terminate because reachability is monotone and the loop is depth-bounded.
+Unknown start/target nodes are handled by the engine forcing depth 0 (the
+dummy row would otherwise let an unknown start "reach" an unknown target).
 """
 
 from __future__ import annotations
@@ -278,7 +283,7 @@ def packed_batched_check(
 
     def cond(state):
         i, f, hit, done = state
-        return jnp.logical_and(i < max_steps, ~jnp.all(done))
+        return jnp.logical_and(i <= max_steps, ~jnp.all(done))
 
     def body(state):
         i, f, hit, done = state
@@ -286,10 +291,18 @@ def packed_batched_check(
             f, src_all, dst_all, n_out, interpret=interpret
         )
         probe = p_full[padded_nodes:]
+        # probe row b = OR of f[target_b] BEFORE this pass: at iteration i
+        # (i >= 1) that is "dist(target) in [1, i]" — see module docstring
         reached = _probe_hits(probe, w)
-        hit = jnp.logical_or(hit, jnp.logical_and(reached, i < depth))
-        f = f | p_full[:padded_nodes]  # bitwise: each bit is one request
-        done = jnp.logical_or(hit, (i + 1) >= depth)
+        hit = jnp.logical_or(
+            hit,
+            jnp.logical_and(reached, jnp.logical_and(i >= 1, i <= depth)),
+        )
+        p = p_full[:padded_nodes]  # bitwise: each bit is one request
+        # iteration 0 REPLACES the frontier (drops the start bit: it is
+        # dist 0, not a reachable node); later iterations accumulate
+        f = jnp.where(i == 0, p, f | p)
+        done = jnp.logical_or(hit, i >= depth)
         return i + 1, f, hit, done
 
     hit0 = jnp.zeros((bsz,), dtype=bool)

@@ -31,7 +31,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from ..engine.check import DEFAULT_MAX_DEPTH, clamp_depth
+from ..engine.check import DEFAULT_MAX_DEPTH
 from ..graph.snapshot import GraphSnapshot, SnapshotManager
 from ..relationtuple.definitions import RelationTuple, SubjectSet
 
@@ -174,18 +174,65 @@ class ShardedCheckEngine:
         if not requests:
             return []
         snap = self.snapshots.snapshot()
-        dev_src, dev_dst = self._device_arrays(snap)
         n = len(requests)
+        # encode with the same two C-speed map() passes the closure engine
+        # uses — no per-request Python attribute chasing in the hot loop
+        get = snap.vocab._id_of.get
+        pn = snap.padded_nodes
+        dummy = snap.dummy_node
+        skeys = [(r.namespace, r.object, r.relation) for r in requests]
+        tkeys = [
+            (s.id,) if not isinstance(s, SubjectSet)
+            else (s.namespace, s.object, s.relation)
+            for s in (r.subject for r in requests)
+        ]
+        start = np.array(
+            [dummy if v is None or v >= pn else v for v in map(get, skeys)],
+            dtype=np.int64,
+        )
+        target = np.array(
+            [dummy if v is None or v >= pn else v for v in map(get, tkeys)],
+            dtype=np.int64,
+        )
+        if depths is not None:
+            want = np.asarray(depths, dtype=np.int32)
+        else:
+            want = np.full(n, max_depth, dtype=np.int32)
+        return self.check_ids(start, target, depths=want).tolist()
+
+    def check_ids(
+        self,
+        start: np.ndarray,
+        target: np.ndarray,
+        is_id: Optional[np.ndarray] = None,
+        depths: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Array-native sharded check: vocab-encoded node ids in, bool[n]
+        out — the same contract as ClosureCheckEngine.check_ids (is_id is
+        accepted for interface parity; the lockstep BFS treats id and set
+        targets uniformly). Unknown/overflow ids clamp to the inert dummy
+        node, which can neither reach nor be reached."""
+        del is_id
+        start = np.asarray(start, dtype=np.int64)
+        if len(start) == 0:
+            return np.zeros(0, dtype=bool)
+        target = np.asarray(target, dtype=np.int64)
+        snap = self.snapshots.snapshot()
+        dev_src, dev_dst = self._device_arrays(snap)
+        n = len(start)
         b = self._bucket_batch(n)
         dummy = snap.dummy_node
-        start = np.full(b, dummy, dtype=np.int32)
-        target = np.full(b, dummy, dtype=np.int32)
+        gmax = self.global_max_depth
+        s = np.full(b, dummy, dtype=np.int32)
+        t = np.full(b, dummy, dtype=np.int32)
         depth = np.ones(b, dtype=np.int32)
-        for i, r in enumerate(requests):
-            start[i] = snap.node_for_set(r.namespace, r.object, r.relation)
-            target[i] = snap.node_for_subject(r.subject)
-            want = depths[i] if depths is not None else max_depth
-            depth[i] = clamp_depth(want, self.global_max_depth)
+        s[:n] = np.where(start >= snap.padded_nodes, dummy, start)
+        t[:n] = np.where(target >= snap.padded_nodes, dummy, target)
+        if depths is None:
+            depth[:n] = gmax
+        else:
+            want = np.asarray(depths, dtype=np.int32)
+            depth[:n] = np.where((want <= 0) | (want > gmax), gmax, want)
         data_sharding = NamedSharding(self.mesh, P("data"))
         local_edges = snap.padded_edges // self.n_edge
         chunk = local_edges
@@ -194,15 +241,15 @@ class ShardedCheckEngine:
         hit = sharded_check(
             dev_src,
             dev_dst,
-            jax.device_put(start, data_sharding),
-            jax.device_put(target, data_sharding),
+            jax.device_put(s, data_sharding),
+            jax.device_put(t, data_sharding),
             jax.device_put(depth, data_sharding),
             mesh=self.mesh,
             padded_nodes=snap.padded_nodes,
             edge_chunk=chunk,
             max_steps=self.global_max_depth,
         )
-        return np.asarray(hit)[:n].tolist()
+        return np.asarray(hit)[:n].copy()
 
     def subject_is_allowed(
         self, requested: RelationTuple, max_depth: int = 0

@@ -26,7 +26,7 @@ from ..utils.errors import ErrInvalidTuple, ErrMalformedInput
 from ..utils.pagination import PaginationOptions
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class SubjectID:
     """A concrete subject, e.g. a user id."""
 
@@ -42,7 +42,7 @@ class SubjectID:
         return isinstance(other, SubjectID) and other.id == self.id
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class SubjectSet:
     """An indirect subject: all subjects that have `relation` on `namespace:object`."""
 
@@ -100,7 +100,7 @@ def subject_from_dict(d: Mapping) -> Subject:
         raise ErrMalformedInput(f"malformed subject: missing {e}") from e
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class RelationTuple:
     """namespace:object#relation@subject — one edge of the permission graph."""
 
@@ -183,7 +183,7 @@ class RelationTuple:
         )
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class RelationQuery:
     """Partial-match filter; None fields are wildcards.
 

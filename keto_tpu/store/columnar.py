@@ -64,6 +64,20 @@ class _StringPool:
             self._strings.append(s)
         return i
 
+    def intern_bulk(self, strings: Sequence[str]) -> np.ndarray:
+        """C-speed bulk intern (same dict-pass structure as
+        NodeVocab.intern_bulk)."""
+        id_of = self._id_of
+        ids = list(map(id_of.get, strings))
+        if None in ids:
+            seen = dict.fromkeys(strings)
+            new = [s for s in seen if s not in id_of]
+            n0 = len(self._strings)
+            id_of.update(zip(new, range(n0, n0 + len(new))))
+            self._strings.extend(new)
+            ids = list(map(id_of.__getitem__, strings))
+        return np.fromiter(ids, dtype=np.int32, count=len(ids))
+
     def lookup(self, s: str) -> Optional[int]:
         return self._id_of.get(s)
 
@@ -102,8 +116,26 @@ class ColumnarTupleStore(Manager):
             "alive": np.empty(cap, bool),
         }
         # row lookup for dedup/delete: (src_node << 32 | dst_node) -> row
-        # index (packed int keys so bulk paths can use C-speed map())
+        # index (packed int keys so point paths can use C-speed map()).
+        # LAZY after bulk loads: building a 100M-entry dict costs minutes
+        # and the graph/serving path never reads it — per-tuple write/
+        # delete rebuilds it on demand (_ensure_row_index); bulk dedup
+        # meanwhile uses sorted key arrays (_key_chunks).
         self._row_of: dict[int, int] = {}
+        self._row_index_dirty = False
+        self._key_chunks: list[np.ndarray] = []  # sorted int64, per bulk load
+        # node id -> string-pool ids, extended lazily as the vocab grows;
+        # -1 marks "not applicable" (sid for set keys, ns/obj/rel for id
+        # keys). Lets bulk loads derive per-row columns by fancy indexing
+        # instead of per-row Python interning. Also LAZY: bulk loads leave
+        # the derived per-row string columns unfilled until a query or
+        # decode needs them (_ensure_derived).
+        self._node_cols_len = 0
+        self._node_ns = np.empty(0, np.int32)
+        self._node_obj = np.empty(0, np.int32)
+        self._node_rel = np.empty(0, np.int32)
+        self._node_sid = np.empty(0, np.int32)
+        self._derived_len = 0  # rows [0, _derived_len) have string columns
         self._version = 0
         self._listeners: list[Callable[[int], None]] = []
         self._delta_listeners: list[
@@ -180,6 +212,8 @@ class ColumnarTupleStore(Manager):
         return src, dst
 
     def _decode_row(self, row: int) -> RelationTuple:
+        if row >= self._derived_len:
+            self._ensure_derived()
         c = self._cols
         if c["sub_is_set"][row]:
             subject: Subject = SubjectSet(
@@ -196,8 +230,68 @@ class ColumnarTupleStore(Manager):
             subject=subject,
         )
 
+    def _row_keys(self) -> np.ndarray:
+        n = self._n
+        return (
+            self._cols["src_node"][:n].astype(np.int64) << 32
+        ) | self._cols["dst_node"][:n].astype(np.int64)
+
+    def _ensure_row_index(self) -> None:
+        """Rebuild the point-lookup dict after bulk loads left it stale.
+        Once rebuilt the dict is authoritative and the bulk key chunks are
+        dropped (they may contain keys of since-deleted rows)."""
+        if not self._row_index_dirty:
+            return
+        keys = self._row_keys()
+        alive_rows = np.nonzero(self._cols["alive"][: self._n])[0]
+        self._row_of = dict(
+            zip(keys[alive_rows].tolist(), alive_rows.tolist())
+        )
+        self._key_chunks = []
+        self._row_index_dirty = False
+
+    def _bulk_existing(self, keys: np.ndarray) -> np.ndarray:
+        """bool[n]: key already present? Union of the point dict (always
+        valid for the rows it covers) and the bulk-loaded sorted chunks."""
+        mask = np.zeros(len(keys), dtype=bool)
+        if self._row_of:
+            mask |= np.fromiter(
+                map(self._row_of.__contains__, keys.tolist()),
+                dtype=bool,
+                count=len(keys),
+            )
+        for chunk in self._key_chunks:
+            pos = np.searchsorted(chunk, keys)
+            in_range = pos < len(chunk)
+            hit = np.zeros(len(keys), dtype=bool)
+            hit[in_range] = chunk[pos[in_range]] == keys[in_range]
+            mask |= hit
+        return mask
+
+    def _ensure_derived(self) -> None:
+        """Materialize the per-row string-pool columns bulk loads defer
+        (queries/decodes need them; the graph path never does)."""
+        n = self._n
+        if self._derived_len >= n:
+            return
+        self._extend_node_cols()
+        sl = slice(self._derived_len, n)
+        c = self._cols
+        src_ids = c["src_node"][sl]
+        dst_ids = c["dst_node"][sl]
+        c["ns"][sl] = self._node_ns[src_ids]
+        c["obj"][sl] = self._node_obj[src_ids]
+        c["rel"][sl] = self._node_rel[src_ids]
+        c["sub_is_set"][sl] = self._node_sid[dst_ids] < 0
+        c["sub_ns"][sl] = self._node_ns[dst_ids]
+        c["sub_obj"][sl] = self._node_obj[dst_ids]
+        c["sub_rel"][sl] = self._node_rel[dst_ids]
+        c["sub_id"][sl] = self._node_sid[dst_ids]
+        self._derived_len = n
+
     def _insert_locked(self, t: RelationTuple) -> Optional[RelationTuple]:
         """Insert one tuple; returns it when fresh, None when duplicate."""
+        self._ensure_row_index()
         self._ensure_capacity(1)
         row = self._n
         src, dst = self._encode_row(t, row)
@@ -208,9 +302,12 @@ class ColumnarTupleStore(Manager):
         self._row_of[key] = row
         self._n += 1
         self._live += 1
+        if self._derived_len == row:
+            self._derived_len = row + 1  # _encode_row filled this row
         return t
 
     def _delete_locked(self, t: RelationTuple) -> Optional[RelationTuple]:
+        self._ensure_row_index()
         src = self.vocab.lookup(set_key(t.namespace, t.object, t.relation))
         dst = self.vocab.lookup(subject_node_key(t.subject))
         if src is None or dst is None:
@@ -229,6 +326,12 @@ class ColumnarTupleStore(Manager):
         c = self._cols
         n = self._n
         mask = c["alive"][:n].copy()
+        if (
+            query.namespace is not None
+            or query.object is not None
+            or query.relation is not None
+        ):
+            self._ensure_derived()
         if query.namespace is not None:
             i = self._ns.lookup(query.namespace)
             mask &= (
@@ -300,6 +403,7 @@ class ColumnarTupleStore(Manager):
 
     def delete_all_relation_tuples(self, query: RelationQuery) -> None:
         with self._lock:
+            self._ensure_row_index()
             rows = np.nonzero(self._query_mask(query))[0]
             gone = [self._decode_row(int(r)) for r in rows]
             self._cols["alive"][rows] = False
@@ -332,6 +436,37 @@ class ColumnarTupleStore(Manager):
 
     # -- bulk + snapshot support ----------------------------------------------
 
+    def _extend_node_cols(self) -> None:
+        """Extend the node-id -> pool-id arrays to cover every interned
+        vocab key. One pass over NEW keys only (C-speed comprehensions +
+        bulk pool interns); bulk loads then derive per-row columns with
+        numpy fancy indexing instead of 100M-iteration Python loops."""
+        n = len(self.vocab)
+        m = n - self._node_cols_len
+        if m <= 0:
+            return
+        new_keys = self.vocab._key_of[self._node_cols_len : n]
+        is_set = np.fromiter(
+            (len(k) == 3 for k in new_keys), dtype=bool, count=m
+        )
+        ns = np.full(m, -1, np.int32)
+        ob = np.full(m, -1, np.int32)
+        rl = np.full(m, -1, np.int32)
+        sid = np.full(m, -1, np.int32)
+        set_keys = [k for k in new_keys if len(k) == 3]
+        id_keys = [k for k in new_keys if len(k) != 3]
+        if set_keys:
+            ns[is_set] = self._ns.intern_bulk([k[0] for k in set_keys])
+            ob[is_set] = self._obj.intern_bulk([k[1] for k in set_keys])
+            rl[is_set] = self._rel.intern_bulk([k[2] for k in set_keys])
+        if id_keys:
+            sid[~is_set] = self._sid.intern_bulk([k[0] for k in id_keys])
+        self._node_ns = np.concatenate([self._node_ns, ns])
+        self._node_obj = np.concatenate([self._node_obj, ob])
+        self._node_rel = np.concatenate([self._node_rel, rl])
+        self._node_sid = np.concatenate([self._node_sid, sid])
+        self._node_cols_len = n
+
     def bulk_load_edges(
         self,
         src_keys: Sequence,
@@ -341,7 +476,9 @@ class ColumnarTupleStore(Manager):
         are (ns, obj, rel) triples, dst_keys are (id,) or (ns, obj, rel).
         Skips per-tuple namespace validation (input is trusted, e.g. a
         generator or a dump) but keeps write idempotence: duplicates within
-        the input and against existing rows are dropped."""
+        the input and against existing rows are dropped. All passes are
+        C-speed dict/numpy operations — no per-row Python loop — so this
+        path sustains the 100M-tuple BASELINE configs."""
         n_in = len(src_keys)
         if n_in == 0:
             return
@@ -355,66 +492,25 @@ class ColumnarTupleStore(Manager):
             )
             _, first = np.unique(keys_all, return_index=True)
             first.sort()
-            existing = np.fromiter(
-                map(self._row_of.__contains__, keys_all[first].tolist()),
-                dtype=bool,
-                count=len(first),
-            )
+            existing = self._bulk_existing(keys_all[first])
             take = first[~existing]
             n_new = len(take)
             if n_new:
                 src_ids = src_all[take]
                 dst_ids = dst_all[take]
-                src_sel = [src_keys[i] for i in take]
-                dst_sel = [dst_keys[i] for i in take]
-                ns_ids = np.fromiter(
-                    (self._ns.intern(k[0]) for k in src_sel),
-                    np.int32,
-                    count=n_new,
-                )
-                obj_ids = np.fromiter(
-                    (self._obj.intern(k[1]) for k in src_sel),
-                    np.int32,
-                    count=n_new,
-                )
-                rel_ids = np.fromiter(
-                    (self._rel.intern(k[2]) for k in src_sel),
-                    np.int32,
-                    count=n_new,
-                )
-                is_set = np.fromiter(
-                    (len(k) == 3 for k in dst_sel), bool, count=n_new
-                )
-                sub_ns = np.full(n_new, -1, np.int32)
-                sub_obj = np.full(n_new, -1, np.int32)
-                sub_rel = np.full(n_new, -1, np.int32)
-                sub_id = np.full(n_new, -1, np.int32)
-                for i, k in enumerate(dst_sel):
-                    if len(k) == 3:
-                        sub_ns[i] = self._ns.intern(k[0])
-                        sub_obj[i] = self._obj.intern(k[1])
-                        sub_rel[i] = self._rel.intern(k[2])
-                    else:
-                        sub_id[i] = self._sid.intern(k[0])
                 self._ensure_capacity(n_new)
                 n0 = self._n
                 sl = slice(n0, n0 + n_new)
                 c = self._cols
-                c["ns"][sl] = ns_ids
-                c["obj"][sl] = obj_ids
-                c["rel"][sl] = rel_ids
-                c["sub_is_set"][sl] = is_set
-                c["sub_ns"][sl] = sub_ns
-                c["sub_obj"][sl] = sub_obj
-                c["sub_rel"][sl] = sub_rel
-                c["sub_id"][sl] = sub_id
+                # only the graph columns are written here; the per-row
+                # string columns and the point-lookup dict materialize
+                # lazily (_ensure_derived / _ensure_row_index) — at 100M
+                # rows they cost minutes the serving path never repays
                 c["src_node"][sl] = src_ids
                 c["dst_node"][sl] = dst_ids
                 c["alive"][sl] = True
-                row_of = self._row_of
-                key_list = keys_all[take].tolist()
-                for i, key in enumerate(key_list):
-                    row_of[key] = n0 + i
+                self._key_chunks.append(np.sort(keys_all[take]))
+                self._row_index_dirty = True
                 self._n += n_new
                 self._live += n_new
             self._version += 1

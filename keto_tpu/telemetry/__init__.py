@@ -1,0 +1,25 @@
+"""Observability: structured logging, tracing spans, and metrics.
+
+The reference wires logrusx structured logging + request-logging middleware
++ OpenTracing on every router/server (reference internal/driver/
+registry_default.go:118-136, :276, :289-291, :337-367). This package is the
+keto_tpu equivalent, with zero external dependencies (the runtime image has
+no OTLP/Jaeger client): spans export to the structured log and to an
+in-process ring buffer, metrics export in Prometheus text format on
+GET /metrics of both planes.
+"""
+
+from .logging import configure_logging, get_logger
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .tracing import Span, Tracer
+
+__all__ = [
+    "configure_logging",
+    "get_logger",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "Tracer",
+]

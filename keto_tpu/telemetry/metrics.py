@@ -1,0 +1,221 @@
+"""Metrics: counters, gauges, histograms with Prometheus text exposition.
+
+The reference snapshot predates Keto's own Prometheus endpoint (SURVEY.md
+§5 "No Prometheus endpoint in this snapshot"); this is a deliberate
+upgrade: a dependency-free registry served at GET /metrics on both planes.
+
+Thread-safety: one lock per metric; label sets materialize child series on
+first use (the prometheus_client model, reimplemented in ~100 lines because
+the runtime image does not ship the client library).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Optional, Sequence
+
+# latency buckets in seconds, spaced for a sub-10ms p95 target
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0,
+)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: dict[tuple, "_Metric"] = {}
+
+    def labels(self, **labels):
+        key = tuple(labels.get(n, "") for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+            return child
+
+    def _series(self):
+        """[(label-dict, child)] — the unlabeled metric is its own series."""
+        if not self.labelnames:
+            return [({}, self)]
+        with self._lock:
+            return [
+                (dict(zip(self.labelnames, key)), child)
+                for key, child in self._children.items()
+            ]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help, labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._value = 0.0
+
+    def _make_child(self):
+        return Counter(self.name, self.help)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _expose(self, labels):
+        return [f"{self.name}{_fmt_labels(labels)} {self._value}"]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help, labelnames=(), fn=None):
+        super().__init__(name, help, labelnames)
+        self._value = 0.0
+        self._fn = fn  # callable gauges sample at scrape time
+
+    def _make_child(self):
+        return Gauge(self.name, self.help)
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            return float(self._fn())
+        return self._value
+
+    def _expose(self, labels):
+        return [f"{self.name}{_fmt_labels(labels)} {self.value}"]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self._sum = 0.0
+
+    def _make_child(self):
+        return Histogram(self.name, self.help, buckets=self.buckets)
+
+    def observe(self, value: float) -> None:
+        # le-inclusive bucket semantics: a value equal to a boundary
+        # belongs to that bucket
+        i = bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+
+    def percentile(self, q: float) -> float:
+        """Approximate quantile from bucket counts (upper bound of the
+        bucket containing the q-th observation) — for in-process
+        introspection and tests, not exposition."""
+        with self._lock:
+            total = sum(self._counts)
+            if total == 0:
+                return 0.0
+            rank = q * total
+            acc = 0
+            for i, c in enumerate(self._counts):
+                acc += c
+                if acc >= rank:
+                    return (
+                        self.buckets[i]
+                        if i < len(self.buckets)
+                        else float("inf")
+                    )
+        return float("inf")
+
+    @property
+    def count(self) -> int:
+        return sum(self._counts)
+
+    def _expose(self, labels):
+        lines = []
+        acc = 0
+        for b, c in zip(self.buckets, self._counts):
+            acc += c
+            lb = dict(labels, le=repr(b) if b != int(b) else str(b))
+            lines.append(f"{self.name}_bucket{_fmt_labels(lb)} {acc}")
+        acc += self._counts[-1]
+        lines.append(
+            f'{self.name}_bucket{_fmt_labels(dict(labels, le="+Inf"))} {acc}'
+        )
+        lines.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sum}")
+        lines.append(f"{self.name}_count{_fmt_labels(labels)} {acc}")
+        return lines
+
+
+class MetricsRegistry:
+    """Named metrics + text exposition (GET /metrics)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _register(self, cls, name, help, labelnames=(), **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, labelnames, **kw)
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=(), fn=None) -> Gauge:
+        return self._register(Gauge, name, help, labelnames, fn=fn)
+
+    def histogram(
+        self, name, help="", labelnames=(), buckets=DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self._register(
+            Histogram, name, help, labelnames, buckets=buckets
+        )
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def expose(self) -> str:
+        """Prometheus text format v0.0.4."""
+        out = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in sorted(metrics, key=lambda m: m.name):
+            out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+            for labels, child in m._series():
+                out.extend(child._expose(labels))
+        return "\n".join(out) + "\n"

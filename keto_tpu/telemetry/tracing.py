@@ -1,0 +1,105 @@
+"""Tracing: lightweight spans over engine phases and requests.
+
+The reference attaches OpenTracing middleware/interceptors everywhere
+(reference internal/driver/registry_default.go:289-291,344-346,360-362 and
+config `tracing.*`, provider.go:178-188). The runtime image has no OTLP
+exporter, so spans here export two ways:
+
+- to the structured log (``tracing.provider: log``) — one line per span
+  with name, duration, parentage, and attributes;
+- always to a bounded in-process ring buffer, which tests and debug
+  endpoints can read back.
+
+Span context propagates through a contextvar, so nested ``with
+tracer.span(...)`` calls build real parent/child trees across the serving
+stack (REST handler -> batcher -> engine -> closure build) without any
+explicit plumbing.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = (
+    contextvars.ContextVar("keto_tpu_span", default=None)
+)
+
+_ids = itertools.count(1)
+
+
+class Span:
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start", "duration",
+        "attrs", "_tracer", "_token",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+        parent = _current_span.get()
+        self.parent_id = parent.span_id if parent else None
+        self.trace_id = parent.trace_id if parent else next(_ids)
+        self.span_id = next(_ids)
+        self.start = time.time()
+        self.duration = None
+        self._tracer = tracer
+        self._token = None
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def __enter__(self) -> "Span":
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.duration = time.time() - self.start
+        if exc_type is not None:
+            self.attrs["error"] = repr(exc)
+        _current_span.reset(self._token)
+        self._tracer._finish(self)
+
+
+class Tracer:
+    """Factory + exporter for spans. ``provider``: "log" mirrors every
+    finished span into the structured log; anything else keeps spans only
+    in the ring buffer."""
+
+    def __init__(
+        self, provider: str = "", logger=None, buffer_size: int = 2048
+    ):
+        self.provider = provider
+        self._logger = logger
+        self._lock = threading.Lock()
+        self._finished: deque[Span] = deque(maxlen=buffer_size)
+
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def _finish(self, span: Span) -> None:
+        with self._lock:
+            self._finished.append(span)
+        if self.provider == "log" and self._logger is not None:
+            self._logger.debug(
+                "span",
+                span=span.name,
+                trace=span.trace_id,
+                parent=span.parent_id or 0,
+                ms=round(1000 * span.duration, 3),
+                **span.attrs,
+            )
+
+    def finished(self, name: Optional[str] = None) -> list[Span]:
+        with self._lock:
+            spans = list(self._finished)
+        if name is not None:
+            spans = [s for s in spans if s.name == name]
+        return spans
+
+
+NOOP_TRACER = Tracer()

@@ -57,6 +57,7 @@ def server():
     cfg = Config(
         values={
             "namespaces": [{"id": 1, "name": "videos"}, {"id": 2, "name": "n"}],
+            "log": {"level": "error"},
             "serve": {
                 "read": {"port": 0, "host": "127.0.0.1"},
                 "write": {"port": 0, "host": "127.0.0.1"},

@@ -17,6 +17,7 @@ def server():
     cfg = Config(
         values={
             "namespaces": [{"id": 1, "name": "videos"}],
+            "log": {"level": "error"},
             "serve": {
                 "read": {"port": 0, "host": "127.0.0.1"},
                 "write": {"port": 0, "host": "127.0.0.1"},

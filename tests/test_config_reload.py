@@ -1,0 +1,194 @@
+"""Config hot-reload + TLS serving (reference provider.go:58-104: watch the
+config file, rebuild the namespace manager on change, immutable DSN/serve
+keys; TLS per the serve.*.tls schema)."""
+
+import ssl
+import subprocess
+import time
+
+import grpc
+import httpx
+import pytest
+
+from keto_tpu.driver import Config
+from keto_tpu.utils.errors import ErrMalformedInput
+from tests.test_api_server import ServerFixture
+
+
+def _write_cfg(path, namespaces, dsn="memory", extra=""):
+    ns_lines = "".join(
+        f"  - name: {n}\n    id: {i}\n" for i, n in enumerate(namespaces, 1)
+    )
+    path.write_text(
+        f"dsn: {dsn}\nnamespaces:\n{ns_lines}{extra}"
+    )
+
+
+class TestConfigReload:
+    def test_reload_applies_mutable_keys(self, tmp_path):
+        cfg_file = tmp_path / "keto.yml"
+        _write_cfg(cfg_file, ["videos"])
+        cfg = Config(config_file=str(cfg_file), env={})
+        mgr = cfg.namespace_manager()
+        mgr.get_namespace_by_name("videos")
+
+        _write_cfg(cfg_file, ["videos", "files"], extra="log:\n  level: debug\n")
+        applied = cfg.reload()
+        assert set(applied) == {"namespaces", "log"}
+        # the SAME manager object serves the new set (stores hold it)
+        mgr.get_namespace_by_name("files")
+        assert cfg.get("log.level") == "debug"
+
+    def test_immutable_keys_keep_boot_values(self, tmp_path):
+        cfg_file = tmp_path / "keto.yml"
+        _write_cfg(cfg_file, ["videos"])
+        cfg = Config(config_file=str(cfg_file), env={})
+        assert cfg.dsn() == "memory"
+        _write_cfg(
+            cfg_file, ["videos"], dsn=f"sqlite://{tmp_path}/other.db",
+            extra="serve:\n  read:\n    port: 9999\n",
+        )
+        applied = cfg.reload()
+        assert "dsn" not in applied and "serve" not in applied
+        assert cfg.dsn() == "memory"
+        assert cfg.read_api_port() == 4466
+
+    def test_invalid_reload_keeps_previous_config(self, tmp_path):
+        cfg_file = tmp_path / "keto.yml"
+        _write_cfg(cfg_file, ["videos"])
+        cfg = Config(config_file=str(cfg_file), env={})
+        cfg_file.write_text("dsn: memory\nnamespaces: 42\n")
+        with pytest.raises(Exception):
+            cfg.reload()
+        cfg.namespace_manager().get_namespace_by_name("videos")
+
+    def test_inline_to_uri_flip_swaps_inner_manager(self, tmp_path):
+        cfg_file = tmp_path / "keto.yml"
+        _write_cfg(cfg_file, ["videos"])
+        cfg = Config(config_file=str(cfg_file), env={})
+        wrapper = cfg.namespace_manager()
+        ns_file = tmp_path / "ns.yml"
+        ns_file.write_text("- name: remote\n  id: 9\n")
+        cfg_file.write_text(f"dsn: memory\nnamespaces: {ns_file}\n")
+        assert cfg.reload() == ["namespaces"]
+        wrapper.get_namespace_by_name("remote")
+        wrapper.close()
+
+
+class TestServerHotReload:
+    def test_namespace_change_visible_while_serving(self, tmp_path):
+        cfg_file = tmp_path / "keto.yml"
+        _write_cfg(cfg_file, ["videos"])
+        cfg = Config(
+            config_file=str(cfg_file),
+            values={
+                "log": {"level": "error"},
+                "serve": {
+                    "read": {"port": 0, "host": "127.0.0.1"},
+                    "write": {"port": 0, "host": "127.0.0.1"},
+                }
+            },
+            env={},
+        )
+        s = ServerFixture(cfg)
+        s.registry._start_config_watcher(poll_interval_s=0.05)
+        try:
+            # unknown namespace is a 404 before the reload
+            r = httpx.put(
+                f"http://127.0.0.1:{s.write_port}/relation-tuples",
+                json={
+                    "namespace": "files",
+                    "object": "f1",
+                    "relation": "view",
+                    "subject_id": "alice",
+                },
+            )
+            assert r.status_code == 404
+            _write_cfg(cfg_file, ["videos", "files"])
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                r = httpx.put(
+                    f"http://127.0.0.1:{s.write_port}/relation-tuples",
+                    json={
+                        "namespace": "files",
+                        "object": "f1",
+                        "relation": "view",
+                        "subject_id": "alice",
+                    },
+                )
+                if r.status_code == 201:
+                    break
+                time.sleep(0.05)
+            assert r.status_code == 201
+        finally:
+            s.stop()
+
+
+def _make_cert(tmp_path):
+    cert = tmp_path / "tls.crt"
+    key = tmp_path / "tls.key"
+    proc = subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048",
+            "-keyout", str(key), "-out", str(cert), "-days", "1",
+            "-nodes", "-subj", "/CN=127.0.0.1",
+            "-addext", "subjectAltName=IP:127.0.0.1",
+        ],
+        capture_output=True,
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"openssl unavailable: {proc.stderr[:200]}")
+    return cert, key
+
+
+class TestTls:
+    def test_both_protocols_through_tls_mux(self, tmp_path):
+        cert, key = _make_cert(tmp_path)
+        cfg = Config(
+            values={
+                "namespaces": [{"id": 1, "name": "videos"}],
+                "log": {"level": "error"},
+                "serve": {
+                    "read": {
+                        "port": 0,
+                        "host": "127.0.0.1",
+                        "tls": {
+                            "cert": {"path": str(cert)},
+                            "key": {"path": str(key)},
+                        },
+                    },
+                    "write": {"port": 0, "host": "127.0.0.1"},
+                },
+            },
+            env={},
+        )
+        s = ServerFixture(cfg)
+        try:
+            # HTTPS REST through the TLS mux
+            r = httpx.get(
+                f"https://127.0.0.1:{s.read_port}/health/alive",
+                verify=str(cert),
+            )
+            assert r.status_code == 200
+            # plaintext against the TLS port fails
+            with pytest.raises(Exception):
+                httpx.get(
+                    f"http://127.0.0.1:{s.read_port}/health/alive",
+                    timeout=2,
+                )
+            # gRPC with TLS channel credentials through the same port
+            creds = grpc.ssl_channel_credentials(
+                root_certificates=cert.read_bytes()
+            )
+            from keto_tpu.api import health_pb2
+            from keto_tpu.api.services import HealthStub
+
+            with grpc.secure_channel(
+                f"127.0.0.1:{s.read_port}", creds
+            ) as ch:
+                resp = HealthStub(ch).Check(
+                    health_pb2.HealthCheckRequest(), timeout=10
+                )
+            assert resp.status == health_pb2.HealthCheckResponse.SERVING
+        finally:
+            s.stop()

@@ -1,0 +1,155 @@
+"""Legacy single-table data migration (reference single_table.go:26-98 +
+cmd/namespace/migrate_legacy.go:18-117): per-namespace v0.6 tables migrate
+into the current store, invalid subjects are skipped and surfaced, down
+drops the legacy table."""
+
+import pytest
+from click.testing import CliRunner
+
+from keto_tpu.cli import cli
+from keto_tpu.namespace.definitions import MemoryNamespaceManager, Namespace
+from keto_tpu.persistence import SQLiteTupleStore
+from keto_tpu.persistence.legacy import (
+    ErrInvalidTuples,
+    SingleTableMigrator,
+    legacy_table_name,
+)
+from keto_tpu.relationtuple import RelationQuery
+
+
+def _fixture_store(path, namespaces=(Namespace(name="videos", id=7),)):
+    store = SQLiteTupleStore(
+        str(path), namespace_manager=MemoryNamespaceManager(*namespaces)
+    )
+    return store
+
+
+def _seed_legacy(store, ns, rows):
+    m = SingleTableMigrator(store)
+    m.create_legacy_table(ns)
+    store._conn.executemany(
+        f'INSERT INTO "{legacy_table_name(ns)}" '
+        "(shard_id, object, relation, subject, commit_time) "
+        "VALUES (?, ?, ?, ?, CURRENT_TIMESTAMP)",
+        [("s", o, r, s) for o, r, s in rows],
+    )
+    store._conn.commit()
+    return m
+
+
+class TestSingleTableMigrator:
+    def test_discovers_legacy_namespaces(self, tmp_path):
+        store = _fixture_store(tmp_path / "db.sqlite")
+        ns = store.namespace_manager.get_namespace_by_name("videos")
+        m = _seed_legacy(store, ns, [("o", "r", "alice")])
+        assert [n.name for n in m.legacy_namespaces()] == ["videos"]
+
+    def test_migrates_rows_with_subject_reparse(self, tmp_path):
+        store = _fixture_store(tmp_path / "db.sqlite")
+        ns = store.namespace_manager.get_namespace_by_name("videos")
+        m = _seed_legacy(
+            store,
+            ns,
+            [
+                ("/cats", "owner", "cat lady"),
+                # subject-set string grammar ns:obj#rel (definitions.go:137-142)
+                ("/cats/1.mp4", "view", "videos:/cats#owner"),
+            ],
+        )
+        migrated, invalid = m.migrate_namespace(ns)
+        assert migrated == 2 and invalid == []
+        tuples, _ = store.get_relation_tuples(RelationQuery(namespace="videos"))
+        assert len(tuples) == 2
+        by_obj = {t.object: t for t in tuples}
+        assert by_obj["/cats"].subject.id == "cat lady"
+        sub = by_obj["/cats/1.mp4"].subject
+        assert (sub.namespace, sub.object, sub.relation) == (
+            "videos", "/cats", "owner",
+        )
+
+    def test_invalid_subjects_skipped_and_surfaced(self, tmp_path):
+        store = _fixture_store(tmp_path / "db.sqlite")
+        ns = store.namespace_manager.get_namespace_by_name("videos")
+        # "x#y" has a '#' (so it must be a subject set) but no ':' — the
+        # grammar rejects it (reference SubjectFromString)
+        m = _seed_legacy(
+            store, ns, [("o1", "r", "good"), ("o2", "r", "x#y")]
+        )
+        with pytest.raises(ErrInvalidTuples) as e:
+            m.migrate_namespace(ns)
+        assert len(e.value.invalid) == 1
+        assert e.value.invalid[0].object == "o2"
+        # the good row still migrated (skip-and-continue, like the reference)
+        tuples, _ = store.get_relation_tuples(RelationQuery(namespace="videos"))
+        assert len(tuples) == 1
+
+    def test_down_drops_legacy_table(self, tmp_path):
+        store = _fixture_store(tmp_path / "db.sqlite")
+        ns = store.namespace_manager.get_namespace_by_name("videos")
+        m = _seed_legacy(store, ns, [("o", "r", "alice")])
+        m.migrate_namespace(ns)
+        m.migrate_down(ns)
+        assert m.legacy_namespaces() == []
+
+    def test_unconfigured_namespace_table_refuses_migration(self, tmp_path):
+        store = _fixture_store(tmp_path / "db.sqlite", namespaces=())
+        m = SingleTableMigrator(store)
+        m.create_legacy_table(Namespace(name="x", id=42))
+        found = m.legacy_namespaces()
+        assert found[0].name.startswith("<unconfigured:")
+        with pytest.raises(Exception, match="namespace config"):
+            m.migrate_namespace(found[0])
+
+
+class TestNamespaceMigrateCli:
+    def _cfg(self, tmp_path):
+        cfg = tmp_path / "keto.yml"
+        cfg.write_text(
+            f"dsn: sqlite://{tmp_path}/keto.db\n"
+            "namespaces:\n  - name: videos\n    id: 7\n"
+        )
+        return str(cfg)
+
+    def test_legacy_end_to_end(self, tmp_path):
+        cfg = self._cfg(tmp_path)
+        store = _fixture_store(tmp_path / "keto.db")
+        ns = Namespace(name="videos", id=7)
+        _seed_legacy(
+            store, ns, [("/cats", "owner", "cat lady")]
+        )
+        store._conn.close()
+
+        r = CliRunner()
+        res = r.invoke(
+            cli, ["namespace", "migrate", "status", "-c", cfg]
+        )
+        assert res.exit_code == 0 and "videos" in res.output
+
+        res = r.invoke(
+            cli, ["namespace", "migrate", "legacy", "-c", cfg, "--yes"]
+        )
+        assert res.exit_code == 0, res.output
+        assert "migrated 1 tuples" in res.output
+        assert "Successfully migrated down" in res.output
+
+        res = r.invoke(
+            cli, ["namespace", "migrate", "status", "-c", cfg]
+        )
+        assert "no legacy namespace tables found" in res.output
+
+        # the migrated tuple is served by the current store
+        check = SQLiteTupleStore(
+            str(tmp_path / "keto.db"),
+            namespace_manager=MemoryNamespaceManager(ns),
+        )
+        tuples, _ = check.get_relation_tuples(
+            RelationQuery(namespace="videos")
+        )
+        assert len(tuples) == 1
+
+    def test_deprecated_verbs_are_noops(self, tmp_path):
+        r = CliRunner()
+        for verb in ("up", "down"):
+            res = r.invoke(cli, ["namespace", "migrate", verb, "videos"])
+            assert res.exit_code == 0
+            assert "deprecated" in res.output

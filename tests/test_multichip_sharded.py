@@ -80,6 +80,40 @@ def test_sharded_depth_budget_and_writes():
 
 
 @needs_mesh
+def test_sharded_check_ids_matches_object_api():
+    """The array-native path (what the batcher/array clients use) must
+    agree with the object path and the host oracle."""
+    rng = np.random.default_rng(43)
+    store = random_store(rng, n_objects=16, n_users=10, n_edges=220)
+    mgr = SnapshotManager(store)
+    host = CheckEngine(store, max_depth=5)
+    eng = ShardedCheckEngine(mgr, mesh=make_mesh(data=2, edge=4), max_depth=5)
+    snap = mgr.snapshot()
+    reqs = []
+    for _ in range(64):
+        obj = f"o{rng.integers(16)}"
+        rel = f"r{rng.integers(3)}"
+        sub = f"u{rng.integers(10)}"
+        reqs.append(t(f"n:{obj}#{rel}@{sub}"))
+    start = np.array(
+        [snap.node_for_set(r.namespace, r.object, r.relation) for r in reqs],
+        dtype=np.int64,
+    )
+    target = np.array(
+        [snap.node_for_subject(r.subject) for r in reqs], dtype=np.int64
+    )
+    expect = [host.subject_is_allowed(r) for r in reqs]
+    got = eng.check_ids(start, target)
+    assert got.tolist() == expect
+    # ids beyond the snapshot clamp to dummy -> denied, not crash
+    big = np.array([snap.padded_nodes + 5], dtype=np.int64)
+    assert eng.check_ids(big, big).tolist() == [False]
+    assert eng.check_ids(
+        np.empty(0, np.int64), np.empty(0, np.int64)
+    ).tolist() == []
+
+
+@needs_mesh
 def test_sharded_circular_and_unknowns():
     store = InMemoryTupleStore()
     store.write_relation_tuples(t("n:a#r@(n:b#r)"), t("n:b#r@(n:a#r)"))

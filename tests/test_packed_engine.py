@@ -1,0 +1,125 @@
+"""Packed (bitpacked DMA) check path: DeviceCheckEngine(mode="packed") must
+agree bit-for-bit with the host oracle and the scatter path on every
+scenario — including the unknown-node depth-0 contract. On the CPU test
+backend the Pallas kernel runs in interpret mode; on TPU it compiles to
+Mosaic (the bench exercises that path)."""
+
+import numpy as np
+import pytest
+
+from keto_tpu.engine import CheckEngine
+from keto_tpu.engine.device import DeviceCheckEngine
+from keto_tpu.graph import SnapshotManager
+from keto_tpu.relationtuple import RelationTuple
+from keto_tpu.store import InMemoryTupleStore
+
+from test_closure_engine import _random_requests
+from test_device_engines import random_store
+
+
+def t(s: str) -> RelationTuple:
+    return RelationTuple.from_string(s)
+
+
+def make_packed(store, max_depth=5):
+    mgr = SnapshotManager(store)
+    return DeviceCheckEngine(mgr, max_depth=max_depth, mode="packed")
+
+
+class TestPackedScenarios:
+    def test_direct_and_indirect(self):
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(
+            t("n:obj#access@(n:org#member)"),
+            t("n:org#member@(n:team#member)"),
+            t("n:team#member@alice"),
+            t("n:doc#read@bob"),
+        )
+        eng = make_packed(store)
+        assert eng.subject_is_allowed(t("n:obj#access@alice"))
+        assert eng.subject_is_allowed(t("n:doc#read@bob"))
+        assert not eng.subject_is_allowed(t("n:obj#access@bob"))
+        assert not eng.subject_is_allowed(t("n:doc#read@alice"))
+
+    def test_unknown_nodes_denied(self):
+        """The dummy row is shared by unknown starts AND unknown targets;
+        without the depth-0 forcing an unknown start would 'reach' an
+        unknown target through it (ops/packed.py docstring contract)."""
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(t("n:obj#r@alice"))
+        eng = make_packed(store)
+        assert not eng.subject_is_allowed(t("no:thing#here@nobody"))
+        assert not eng.subject_is_allowed(t("n:obj#r@nobody"))
+        assert not eng.subject_is_allowed(t("no:thing#here@alice"))
+
+    def test_depth_budget(self):
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(
+            t("n:obj#r@(n:s1#m)"),
+            t("n:s1#m@(n:s2#m)"),
+            t("n:s2#m@alice"),
+        )
+        eng = make_packed(store, max_depth=10)
+        req = t("n:obj#r@alice")
+        assert not eng.subject_is_allowed(req, max_depth=2)
+        assert eng.subject_is_allowed(req, max_depth=3)
+
+    def test_start_equals_target_needs_real_path(self):
+        """set@same-set is only allowed through an actual cycle — the
+        start bit itself is dist 0 and must not satisfy the probe."""
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(t("n:obj#r@alice"))
+        eng = make_packed(store)
+        assert not eng.subject_is_allowed(t("n:obj#r@(n:obj#r)"))
+
+    def test_exact_depth_boundary(self):
+        """A path of length d must be allowed at depth d and denied at
+        d-1 — the probe-lag compensation boundary."""
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(t("n:a#r@(n:b#r)"), t("n:b#r@u"))
+        eng = make_packed(store, max_depth=2)
+        # budget == global max == path length: needs the extra iteration
+        assert eng.subject_is_allowed(t("n:a#r@u"), max_depth=2)
+        assert not eng.subject_is_allowed(t("n:a#r@u"), max_depth=1)
+
+    def test_cycles_terminate(self):
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(
+            t("n:a#r@(n:b#r)"), t("n:b#r@(n:a#r)")
+        )
+        eng = make_packed(store)
+        assert not eng.subject_is_allowed(t("n:a#r@alice"))
+        assert eng.subject_is_allowed(t("n:a#r@(n:a#r)"))
+
+    def test_write_visibility(self):
+        store = InMemoryTupleStore()
+        eng = make_packed(store)
+        req = t("n:obj#r@alice")
+        assert not eng.subject_is_allowed(req)
+        store.write_relation_tuples(req)
+        assert eng.subject_is_allowed(req)
+
+
+class TestPackedMatchesOracle:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_graphs(self, seed):
+        rng = np.random.default_rng(seed + 400)
+        store = random_store(rng, n_objects=12, n_users=8, n_edges=90)
+        host = CheckEngine(store, max_depth=5)
+        eng = make_packed(store, max_depth=5)
+        reqs = _random_requests(rng, 12, 8, k=48)
+        expect = [host.subject_is_allowed(r) for r in reqs]
+        assert eng.batch_check(reqs) == expect
+
+    def test_per_request_depths(self):
+        rng = np.random.default_rng(77)
+        store = random_store(rng, n_objects=10, n_users=6, n_edges=70)
+        host = CheckEngine(store, max_depth=8)
+        eng = make_packed(store, max_depth=8)
+        reqs = _random_requests(rng, 10, 6, k=32)
+        depths = [int(rng.integers(1, 9)) for _ in reqs]
+        expect = [
+            host.subject_is_allowed(r, max_depth=d)
+            for r, d in zip(reqs, depths)
+        ]
+        assert eng.batch_check(reqs, depths=depths) == expect
