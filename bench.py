@@ -663,20 +663,9 @@ def _sharded_child():
 def run_sharded_bench():
     import subprocess
 
-    env = dict(os.environ)
-    flags = [
-        f
-        for f in env.get("XLA_FLAGS", "").split()
-        if not f.startswith("--xla_force_host_platform_device_count")
-    ]
-    flags.append("--xla_force_host_platform_device_count=8")
-    env.update(
-        {
-            "PALLAS_AXON_POOL_IPS": "",
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": " ".join(flags),
-        }
-    )
+    from __graft_entry__ import virtual_cpu_mesh_env
+
+    env = virtual_cpu_mesh_env(8)
     repo = os.path.dirname(os.path.abspath(__file__))
     proc = subprocess.run(
         [
@@ -712,8 +701,23 @@ def main():
         "BENCH_CONFIGS", "rbac1m,github10m,rbac100m"
     ).split(",")
 
+    # record the environment the numbers were taken in: host core count
+    # bounds the host-query path; the device round-trip decides whether
+    # queries run on-device or host-side (engine query_mode auto-probe)
+    import jax.numpy as jnp
+
+    np.asarray(jnp.zeros(8) + 1)
+    t0 = time.perf_counter()
+    np.asarray(jnp.ones(8) + 1)
+    rt_ms = round(1000 * (time.perf_counter() - t0), 1)
     print(
-        json.dumps({"device": str(jax.devices()[0])}),
+        json.dumps(
+            {
+                "device": str(jax.devices()[0]),
+                "host_cpus": os.cpu_count(),
+                "device_roundtrip_ms": rt_ms,
+            }
+        ),
         file=sys.stderr,
         flush=True,
     )

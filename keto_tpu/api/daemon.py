@@ -212,22 +212,29 @@ class PlaneServer:
     def __init__(
         self, grpc_server: grpc.Server, app: web.Application,
         host: str = "0.0.0.0", port: int = 0, ssl_context=None,
+        expose_backends: bool = False,
     ):
         self.grpc_server = grpc_server
         self.app = app
         self.host = host
         self.port = port
         self.ssl_context = ssl_context
+        self.expose_backends = expose_backends
         self.grpc_port: int = 0
         self.http_port: int = 0
         self._runner: Optional[web.AppRunner] = None
         self._mux: Optional[_MuxedPort] = None
 
     async def start(self) -> int:
-        # with TLS the plaintext backends must not be reachable off-host:
-        # the muxed port is then the only public surface
+        # backends bind loopback by default: they are plaintext and listen
+        # on ephemeral ports, so putting them on the public interface would
+        # silently widen the exposure surface past the configured ports.
+        # serve.<plane>.expose_backend_ports opts in (never under TLS —
+        # that would bypass the TLS terminator)
         backend_host = (
-            "127.0.0.1" if self.ssl_context else (self.host or "0.0.0.0")
+            self.host or "0.0.0.0"
+            if (self.expose_backends and not self.ssl_context)
+            else "127.0.0.1"
         )
         self.grpc_port = self.grpc_server.add_insecure_port(
             f"{backend_host}:0"

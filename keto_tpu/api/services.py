@@ -403,13 +403,20 @@ class HealthStub:
 class _DirectChecker:
     """Unbatched adapter: checker interface over a bare engine."""
 
-    def __init__(self, engine):
+    def __init__(self, engine, max_batch: int = 4096):
         self.engine = engine
+        self.max_batch = max_batch
 
     def check(self, request: RelationTuple, max_depth: int = 0) -> bool:
         return self.engine.subject_is_allowed(request, max_depth)
 
     def check_batch(self, requests, max_depth: int = 0) -> list:
-        return [
-            bool(v) for v in self.engine.batch_check(requests, max_depth)
-        ]
+        out: list = []
+        for i in range(0, len(requests), self.max_batch):
+            out.extend(
+                bool(v)
+                for v in self.engine.batch_check(
+                    requests[i : i + self.max_batch], max_depth
+                )
+            )
+        return out

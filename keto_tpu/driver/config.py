@@ -73,6 +73,9 @@ _PORT_SCHEMA = {
         "cors": _CORS_SCHEMA,
         "max-depth": {"type": "integer", "minimum": 1},
         "tls": _TLS_SCHEMA,
+        # opt-in: bind the plaintext gRPC/HTTP backend ports on the public
+        # host (for protocol-aware LBs); default keeps them loopback-only
+        "expose_backend_ports": {"type": "boolean"},
     },
     "additionalProperties": True,
 }
@@ -270,7 +273,16 @@ class Config:
         applied = []
         for key in changed:
             if key in IMMUTABLE_KEYS:
-                continue  # frozen after boot
+                # frozen after boot — say so, or the operator believes the
+                # new DSN/ports are live
+                from ..telemetry import get_logger
+
+                get_logger("config").warn(
+                    "config key is immutable after boot; keeping the boot "
+                    "value (restart to apply)",
+                    key=key,
+                )
+                continue
             applied.append(key)
         merged = dict(fresh)
         for key in IMMUTABLE_KEYS:

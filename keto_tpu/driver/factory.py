@@ -1,0 +1,82 @@
+"""Registry factories (reference internal/driver/registry_factory.go).
+
+``new_registry`` mirrors NewDefaultRegistry (config file + env + flag
+overrides -> initialized Registry); the ``*_test_registry`` constructors
+mirror NewSqliteTestRegistry / NewTestRegistry (registry_factory.go:56-95):
+pre-wired registries on ephemeral stores with quiet logging and free
+ports, for tests and embedding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .config import Config
+from .registry import Registry
+
+
+def new_registry(
+    config_file: Optional[str] = None,
+    flag_overrides: Optional[dict[str, Any]] = None,
+) -> Registry:
+    """The production constructor: file + env + flags, validated."""
+    return Registry(
+        Config(config_file=config_file, flag_overrides=flag_overrides)
+    )
+
+
+def _test_config(values: Optional[dict] = None, **overrides) -> Config:
+    base: dict = {
+        # free ports on loopback; error-level logs so test output stays
+        # readable (the reference's test registries silence logging too)
+        "serve": {
+            "read": {"port": 0, "host": "127.0.0.1"},
+            "write": {"port": 0, "host": "127.0.0.1"},
+        },
+        "log": {"level": "error"},
+    }
+    merged = dict(base)
+    for k, v in (values or {}).items():
+        if isinstance(v, dict) and isinstance(merged.get(k), dict):
+            inner = dict(merged[k])
+            inner.update(v)
+            merged[k] = inner
+        else:
+            merged[k] = v
+    cfg = Config(values=merged, env={})
+    for key, val in overrides.items():
+        cfg.set_override(key, val)
+    return cfg
+
+
+def new_test_registry(
+    namespaces: tuple[str, ...] = ("videos",),
+    values: Optional[dict] = None,
+    **overrides,
+) -> Registry:
+    """In-memory test registry (reference NewTestRegistry): named
+    namespaces with sequential ids, memory DSN."""
+    vals = dict(values or {})
+    vals.setdefault(
+        "namespaces",
+        [{"id": i, "name": n} for i, n in enumerate(namespaces, 1)],
+    )
+    return Registry(_test_config(vals, **overrides))
+
+
+def new_sqlite_test_registry(
+    path: str,
+    namespaces: tuple[str, ...] = ("videos",),
+    values: Optional[dict] = None,
+    **overrides,
+) -> Registry:
+    """Sqlite-backed test registry with automigration (reference
+    NewSqliteTestRegistry): pass a tmp file path; the schema is applied on
+    first store construction."""
+    vals = dict(values or {})
+    vals["dsn"] = f"sqlite://{path}"
+    vals.setdefault(
+        "namespaces",
+        [{"id": i, "name": n} for i, n in enumerate(namespaces, 1)],
+    )
+    return Registry(_test_config(vals, **overrides))

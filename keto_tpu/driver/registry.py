@@ -310,6 +310,11 @@ class Registry:
                 host=self.config.read_api_host(),
                 port=self.config.read_api_port(),
                 ssl_context=self._ssl_context("read"),
+                expose_backends=bool(
+                    self.config.get(
+                        "serve.read.expose_backend_ports", default=False
+                    )
+                ),
             )
         return self._read_plane
 
@@ -339,6 +344,11 @@ class Registry:
                 host=self.config.write_api_host(),
                 port=self.config.write_api_port(),
                 ssl_context=self._ssl_context("write"),
+                expose_backends=bool(
+                    self.config.get(
+                        "serve.write.expose_backend_ports", default=False
+                    )
+                ),
             )
         return self._write_plane
 

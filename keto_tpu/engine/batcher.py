@@ -63,10 +63,18 @@ class CheckBatcher:
         self, requests: Sequence[RelationTuple], max_depth: int = 0
     ) -> list[bool]:
         """A caller-assembled batch: already amortized, so it skips the
-        queue and dispatches directly (the batch-check transport path)."""
-        return [
-            bool(v) for v in self.engine.batch_check(requests, max_depth)
-        ]
+        queue and dispatches directly (the batch-check transport path).
+        Dispatched in max_batch slices so one giant request cannot balloon
+        the engine's working set past what every other path is capped at."""
+        out: list[bool] = []
+        for i in range(0, len(requests), self.max_batch):
+            out.extend(
+                bool(v)
+                for v in self.engine.batch_check(
+                    requests[i : i + self.max_batch], max_depth
+                )
+            )
+        return out
 
     def close(self) -> None:
         with self._cv:

@@ -241,14 +241,15 @@ class DeviceCheckEngine:
             # distances are an expand-support query, not the packed check's
             # hot path: reuse the COO scatter kernel — cached per snapshot
             # (a fresh upload per expand would re-ship the whole edge list)
-            companion = self._scatter_companion
-            if not (
-                companion is not None
-                and companion.host_src is snap.src
-                and companion.host_dst is snap.dst
-            ):
-                companion = _DeviceGraph(snap, "scatter")
-                self._scatter_companion = companion
+            with self._lock:
+                companion = self._scatter_companion
+                if not (
+                    companion is not None
+                    and companion.host_src is snap.src
+                    and companion.host_dst is snap.dst
+                ):
+                    companion = _DeviceGraph(snap, "scatter")
+                    self._scatter_companion = companion
             dg = companion
         if dg.dense:
             dist = batched_distances_dense(

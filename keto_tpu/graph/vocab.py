@@ -41,6 +41,24 @@ def subject_node_key(subject: Subject) -> NodeKey:
     return set_key(subject.namespace, subject.object, subject.relation)
 
 
+def bulk_intern(id_of: dict, values: list, items) -> np.ndarray:
+    """Append-only bulk intern into an (id_of dict, values list) pair,
+    entirely in C-speed dict passes (no per-item Python loop): resolve via
+    map(), dedupe new items with dict.fromkeys (insertion-ordered), assign
+    their ids with one dict.update(zip(...)). Shared by the node vocab and
+    the columnar store's string pools — the same subtle algorithm must not
+    fork."""
+    ids = list(map(id_of.get, items))
+    if None in ids:
+        seen = dict.fromkeys(items)
+        new = [k for k in seen if k not in id_of]
+        n0 = len(values)
+        id_of.update(zip(new, range(n0, n0 + len(new))))
+        values.extend(new)
+        ids = list(map(id_of.__getitem__, items))
+    return np.fromiter(ids, dtype=np.int32, count=len(ids))
+
+
 class NodeVocab:
     """Append-only bidirectional mapping NodeKey <-> int32 id."""
 
@@ -61,21 +79,9 @@ class NodeVocab:
         return nid
 
     def intern_bulk(self, keys: Sequence[NodeKey]) -> np.ndarray:
-        """Vectorized intern of many keys -> int32 ids, entirely in C-speed
-        dict passes (no per-key Python loop): resolve via map(), dedupe new
-        keys with dict.fromkeys (insertion-ordered), assign their ids with
-        one dict.update(zip(...)). This is what makes 100M-tuple bulk loads
-        minutes instead of tens of minutes."""
-        id_of = self._id_of
-        ids = list(map(id_of.get, keys))
-        if None in ids:
-            seen = dict.fromkeys(keys)
-            new = [k for k in seen if k not in id_of]
-            n0 = len(self._key_of)
-            id_of.update(zip(new, range(n0, n0 + len(new))))
-            self._key_of.extend(new)
-            ids = list(map(id_of.__getitem__, keys))
-        return np.fromiter(ids, dtype=np.int32, count=len(ids))
+        """Vectorized intern of many keys -> int32 ids. This is what makes
+        100M-tuple bulk loads minutes instead of tens of minutes."""
+        return bulk_intern(self._id_of, self._key_of, keys)
 
     def is_set_array(self) -> np.ndarray:
         """bool[len(self)]: True where the node denotes a subject set

@@ -65,18 +65,9 @@ class _StringPool:
         return i
 
     def intern_bulk(self, strings: Sequence[str]) -> np.ndarray:
-        """C-speed bulk intern (same dict-pass structure as
-        NodeVocab.intern_bulk)."""
-        id_of = self._id_of
-        ids = list(map(id_of.get, strings))
-        if None in ids:
-            seen = dict.fromkeys(strings)
-            new = [s for s in seen if s not in id_of]
-            n0 = len(self._strings)
-            id_of.update(zip(new, range(n0, n0 + len(new))))
-            self._strings.extend(new)
-            ids = list(map(id_of.__getitem__, strings))
-        return np.fromiter(ids, dtype=np.int32, count=len(ids))
+        from ..graph.vocab import bulk_intern
+
+        return bulk_intern(self._id_of, self._strings, strings)
 
     def lookup(self, s: str) -> Optional[int]:
         return self._id_of.get(s)

@@ -3,10 +3,12 @@
 The reference treats page tokens as opaque strings end-to-end (reference
 internal/x/pagination.go; token encoding internal/persistence/sql/persister.go:
 internalPagination encodes a page number, parse failures map to
-ErrMalformedPageToken). We keep the same contract — opaque string tokens,
-empty string means "first page" / "no more pages" — but encode an offset
-plus a store-version stamp, which makes tokens robust to concurrent writes
-and lets the device snapshot layer validate freshness.
+ErrMalformedPageToken). We keep the same contract: opaque url-safe tokens
+encoding a result offset, empty string means "first page" / "no more
+pages", and malformed tokens map to ErrMalformedPageToken. Like the
+reference's page numbers, offsets are not stable under concurrent writes —
+a paginating reader may see an item twice or miss one written mid-scan;
+the Check/Expand path gets its consistency story from snaptokens instead.
 """
 
 from __future__ import annotations

@@ -1,0 +1,172 @@
+"""Concurrency stress: writers, checkers, expanders, and config reloads
+hammering one live registry. The reference runs its suite under `go test
+-race` as a separate CI job (reference .circleci/config.yml:57-66); Python
+has no race detector, so this is the analog: shake the lock/snapshot/
+rebuild machinery under real thread interleavings and assert no exceptions,
+no deadlocks, and convergence to the oracle's answers afterward."""
+
+import threading
+import time
+
+import pytest
+
+from keto_tpu.driver.factory import new_test_registry
+from keto_tpu.engine.check import CheckEngine
+from keto_tpu.relationtuple import RelationQuery, RelationTuple, SubjectSet
+
+
+def t(s: str) -> RelationTuple:
+    return RelationTuple.from_string(s)
+
+
+@pytest.mark.parametrize("freshness", ["strong", "bounded"])
+def test_concurrent_writers_and_checkers(freshness):
+    reg = new_test_registry(
+        namespaces=("videos",),
+        values={"engine": {"freshness": freshness, "rebuild_debounce_ms": 0}},
+    )
+    store = reg.store()
+    engine = reg.check_engine()
+    for i in range(8):
+        store.write_relation_tuples(t(f"videos:g{i}#m@u{i}"))
+
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def guard(fn):
+        def run():
+            try:
+                while not stop.is_set():
+                    fn()
+            except BaseException as e:  # surfaced after join
+                errors.append(e)
+
+        return run
+
+    counter = [0]
+
+    def writer():
+        i = counter[0] = counter[0] + 1
+        store.write_relation_tuples(
+            t(f"videos:obj{i % 50}#view@(videos:g{i % 8}#m)")
+        )
+        if i % 7 == 0:
+            store.delete_relation_tuples(
+                t(f"videos:obj{i % 50}#view@(videos:g{i % 8}#m)")
+            )
+
+    def checker():
+        engine.batch_check(
+            [t(f"videos:obj{i}#view@u{i % 8}") for i in range(16)]
+        )
+
+    def expander():
+        reg.expand_engine().build_tree(
+            SubjectSet(namespace="videos", object="obj1", relation="view"),
+            3,
+        )
+
+    threads = [
+        threading.Thread(target=guard(fn), daemon=True)
+        for fn in (writer, writer, checker, checker, expander)
+    ]
+    for th in threads:
+        th.start()
+    time.sleep(3.0)
+    stop.set()
+    for th in threads:
+        th.join(timeout=30)
+        assert not th.is_alive(), "stress thread deadlocked"
+    assert not errors, errors
+
+    # convergence: once writes quiesce, the engine answers must match the
+    # host oracle exactly (bounded freshness catches up)
+    oracle = CheckEngine(store, max_depth=5)
+    reqs = [
+        t(f"videos:obj{i}#view@u{j}") for i in range(20) for j in range(4)
+    ]
+    expect = [oracle.subject_is_allowed(r) for r in reqs]
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if engine.batch_check(reqs) == expect:
+            break
+        time.sleep(0.05)
+    assert engine.batch_check(reqs) == expect
+
+
+def test_batcher_under_concurrent_load():
+    reg = new_test_registry(namespaces=("videos",))
+    store = reg.store()
+    store.write_relation_tuples(t("videos:o#r@alice"))
+    checker = reg.checker()  # CheckBatcher over the closure engine
+    results: list[bool] = []
+    errors: list[BaseException] = []
+
+    def client(i):
+        try:
+            sub = "alice" if i % 2 == 0 else "bob"
+            got = checker.check(t(f"videos:o#r@{sub}"), 0)
+            assert got == (i % 2 == 0)
+            results.append(got)
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(64)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert not errors, errors
+    assert len(results) == 64
+    reg._batcher.close()
+
+
+def test_batch_transport_slices_oversized_batches():
+    """check_batch must dispatch in max_batch slices — one giant request
+    cannot balloon the engine's working set past the cap."""
+    reg = new_test_registry(
+        namespaces=("videos",), values={"engine": {"max_batch": 8}}
+    )
+    reg.store().write_relation_tuples(t("videos:o#r@alice"))
+    checker = reg.checker()
+    reqs = [
+        t(f"videos:o#r@{'alice' if i % 3 == 0 else 'bob'}")
+        for i in range(50)
+    ]
+    got = checker.check_batch(reqs)
+    assert got == [(i % 3 == 0) for i in range(50)]
+    reg._batcher.close()
+
+
+def test_store_isolation_under_concurrent_tenants():
+    """Two registries (tenants) on separate stores: concurrent writes must
+    never leak across (the in-process analog of the reference's
+    IsolationTest, manager_isolation.go:44-138)."""
+    rega = new_test_registry(namespaces=("videos",))
+    regb = new_test_registry(namespaces=("videos",))
+    errors: list[BaseException] = []
+
+    def load(reg, tag):
+        try:
+            for i in range(200):
+                reg.store().write_relation_tuples(
+                    t(f"videos:{tag}{i}#r@u{i}")
+                )
+        except BaseException as e:
+            errors.append(e)
+
+    ta = threading.Thread(target=load, args=(rega, "a"), daemon=True)
+    tb = threading.Thread(target=load, args=(regb, "b"), daemon=True)
+    ta.start(); tb.start()
+    ta.join(timeout=60); tb.join(timeout=60)
+    assert not errors, errors
+    assert len(rega.store()) == 200 and len(regb.store()) == 200
+    a_tuples, _ = rega.store().get_relation_tuples(
+        RelationQuery(namespace="videos"), None
+    )
+    assert all(x.object.startswith("a") for x in a_tuples)
+    assert rega.check_engine().subject_is_allowed(t("videos:a1#r@u1"))
+    assert not rega.check_engine().subject_is_allowed(t("videos:b1#r@u1"))
