@@ -402,6 +402,56 @@ def _grpc_client_proc(port, req_blobs, n_threads, seconds, q):
     q.put((np.array([v for lats in lat_all for v in lats]), elapsed))
 
 
+def _grpc_batch_client_proc(port, batch_blobs, n_threads, seconds, q):
+    """Subprocess gRPC BatchCheck load generator (own GIL): the binary
+    batch transport — each blob is one serialized BatchCheckRequest."""
+    import threading
+
+    import grpc
+
+    from keto_tpu.api import check_service_pb2
+    from keto_tpu.api.services import CheckServiceStub
+
+    reqs = [
+        check_service_pb2.BatchCheckRequest.FromString(b)
+        for b in batch_blobs
+    ]
+    channels = [
+        grpc.insecure_channel(f"127.0.0.1:{port}") for _ in range(2)
+    ]
+    stubs = [CheckServiceStub(ch) for ch in channels]
+    stubs[0].BatchCheck(reqs[0])
+    lat_all = [[] for _ in range(n_threads)]
+    stop = threading.Event()
+
+    def worker(wid):
+        stub = stubs[wid % len(stubs)]
+        my_lat = lat_all[wid]
+        i = wid
+        while not stop.is_set():
+            r = reqs[i % len(reqs)]
+            i += 1
+            t0 = time.perf_counter()
+            stub.BatchCheck(r)
+            my_lat.append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(n_threads)
+    ]
+    t_start = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.time() - t_start
+    for ch in channels:
+        ch.close()
+    q.put((np.array([v for lats in lat_all for v in lats]), elapsed))
+
+
 def _batch_client_proc(port, payloads, n_threads, seconds, q):
     """Subprocess REST /check/batch load generator (own GIL)."""
     import threading
@@ -529,12 +579,25 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
         for s, d in zip(skeys, dkeys)
     ]
     payloads = []
+    grpc_batch_blobs = []
     for _ in range(8):
         sk, dk = sample(rng, batch_size)
+        reqs = to_requests(sk, dk)
         payloads.append(
-            json.dumps(
-                {"tuples": [t.to_dict() for t in to_requests(sk, dk)]}
-            ).encode()
+            json.dumps({"tuples": [t.to_dict() for t in reqs]}).encode()
+        )
+        grpc_batch_blobs.append(
+            check_service_pb2.BatchCheckRequest(
+                tuples=[
+                    check_service_pb2.CheckRequestTuple(
+                        namespace=t.namespace,
+                        object=t.object,
+                        relation=t.relation,
+                        subject=acl_pb2.Subject(id=t.subject.id),
+                    )
+                    for t in reqs
+                ]
+            ).SerializeToString()
         )
 
     ctx = mp.get_context("spawn")
@@ -565,6 +628,13 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
         _batch_client_proc,
         [(http_direct, payloads, 1, seconds) for _ in range(n_procs)],
     )
+    gb_lat, gb_elapsed = drive(
+        _grpc_batch_client_proc,
+        [
+            (grpc_direct, grpc_batch_blobs, 1, seconds)
+            for _ in range(n_procs)
+        ],
+    )
 
     # muxed-port overhead sample: same RPC through the byte-relay port
     mux_lat = []
@@ -591,6 +661,13 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
         "batch_size": batch_size,
         "batch_req_p50_ms": round(1000 * float(np.percentile(b_lat, 50)), 2),
         "batch_req_p95_ms": round(1000 * float(np.percentile(b_lat, 95)), 2),
+        "grpc_batch_rps": round(len(gb_lat) * batch_size / gb_elapsed),
+        "grpc_batch_p50_ms": round(
+            1000 * float(np.percentile(gb_lat, 50)), 2
+        ),
+        "grpc_batch_p95_ms": round(
+            1000 * float(np.percentile(gb_lat, 95)), 2
+        ),
         "mux_grpc_p50_ms": round(1000 * float(np.percentile(mux_lat, 50)), 2),
     }
     return out
@@ -755,6 +832,7 @@ def _print_primary(results):
         primary["check_rps"],
         primary.get("check_rps_encoded") or 0,
         primary.get("batch_rps") or 0,
+        primary.get("grpc_batch_rps") or 0,
     )
     print(
         json.dumps(

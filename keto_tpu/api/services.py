@@ -81,6 +81,34 @@ class CheckServicer:
         except Exception as e:
             _abort(context, e)
 
+    def BatchCheck(self, request, context):
+        """keto_tpu extension: many checks per RPC (binary twin of the
+        REST /check/batch transport)."""
+        try:
+            tuples = []
+            for item in request.tuples:
+                subject = subject_from_proto(
+                    item.subject if item.HasField("subject") else None
+                )
+                if subject is None:
+                    raise ErrMalformedInput(
+                        "batch check tuple without subject"
+                    )
+                tuples.append(
+                    RelationTuple(
+                        namespace=item.namespace,
+                        object=item.object,
+                        relation=item.relation,
+                        subject=subject,
+                    )
+                )
+            allowed = self.checker.check_batch(tuples, request.max_depth)
+            return check_service_pb2.BatchCheckResponse(
+                allowed=allowed, snaptoken=self.snaptoken_fn()
+            )
+        except Exception as e:
+            _abort(context, e)
+
 
 class ExpandServicer:
     def __init__(self, expand_engine, snaptoken_fn: Callable[[], str]):
@@ -242,7 +270,12 @@ def add_check_service(server, servicer: CheckServicer):
                     servicer.Check,
                     check_service_pb2.CheckRequest,
                     check_service_pb2.CheckResponse,
-                )
+                ),
+                "BatchCheck": _unary(
+                    servicer.BatchCheck,
+                    check_service_pb2.BatchCheckRequest,
+                    check_service_pb2.BatchCheckResponse,
+                ),
             },
         ),
     ))
@@ -343,6 +376,15 @@ class CheckServiceStub:
             request_serializer=check_service_pb2.CheckRequest.SerializeToString,
             response_deserializer=check_service_pb2.CheckResponse.FromString,
         )
+        self.BatchCheck = channel.unary_unary(
+            f"/{_PKG}.CheckService/BatchCheck",
+            request_serializer=(
+                check_service_pb2.BatchCheckRequest.SerializeToString
+            ),
+            response_deserializer=(
+                check_service_pb2.BatchCheckResponse.FromString
+            ),
+        )
 
 
 class ExpandServiceStub:
@@ -411,12 +453,8 @@ class _DirectChecker:
         return self.engine.subject_is_allowed(request, max_depth)
 
     def check_batch(self, requests, max_depth: int = 0) -> list:
-        out: list = []
-        for i in range(0, len(requests), self.max_batch):
-            out.extend(
-                bool(v)
-                for v in self.engine.batch_check(
-                    requests[i : i + self.max_batch], max_depth
-                )
-            )
-        return out
+        from ..engine.batcher import dispatch_batched
+
+        return dispatch_batched(
+            self.engine, requests, max_depth, self.max_batch
+        )

@@ -26,6 +26,8 @@ def new_registry(
 
 
 def _test_config(values: Optional[dict] = None, **overrides) -> Config:
+    from .config import _deep_merge
+
     base: dict = {
         # free ports on loopback; error-level logs so test output stays
         # readable (the reference's test registries silence logging too)
@@ -35,15 +37,7 @@ def _test_config(values: Optional[dict] = None, **overrides) -> Config:
         },
         "log": {"level": "error"},
     }
-    merged = dict(base)
-    for k, v in (values or {}).items():
-        if isinstance(v, dict) and isinstance(merged.get(k), dict):
-            inner = dict(merged[k])
-            inner.update(v)
-            merged[k] = inner
-        else:
-            merged[k] = v
-    cfg = Config(values=merged, env={})
+    cfg = Config(values=_deep_merge(base, values or {}), env={})
     for key, val in overrides.items():
         cfg.set_override(key, val)
     return cfg

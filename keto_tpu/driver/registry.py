@@ -228,7 +228,10 @@ class Registry:
             engine = self.check_engine()
             if isinstance(engine, CheckEngine):
                 # host oracle: per-request evaluation, nothing to batch
-                self._checker = _DirectChecker(engine)
+                self._checker = _DirectChecker(
+                    engine,
+                    max_batch=int(self.config.get("engine.max_batch")),
+                )
             else:
                 # device-backed engines (frontier/closure/sharded) amortize
                 # per-batch costs — route through the batching seam

@@ -63,18 +63,10 @@ class CheckBatcher:
         self, requests: Sequence[RelationTuple], max_depth: int = 0
     ) -> list[bool]:
         """A caller-assembled batch: already amortized, so it skips the
-        queue and dispatches directly (the batch-check transport path).
-        Dispatched in max_batch slices so one giant request cannot balloon
-        the engine's working set past what every other path is capped at."""
-        out: list[bool] = []
-        for i in range(0, len(requests), self.max_batch):
-            out.extend(
-                bool(v)
-                for v in self.engine.batch_check(
-                    requests[i : i + self.max_batch], max_depth
-                )
-            )
-        return out
+        queue and dispatches directly (the batch-check transport path)."""
+        return dispatch_batched(
+            self.engine, requests, max_depth, self.max_batch
+        )
 
     def close(self) -> None:
         with self._cv:
@@ -119,3 +111,20 @@ class CheckBatcher:
             for (_, _, f), allowed in zip(batch, results):
                 if not f.done():
                     f.set_result(bool(allowed))
+
+
+def dispatch_batched(
+    engine, requests: Sequence[RelationTuple], max_depth: int, max_batch: int
+) -> list[bool]:
+    """Dispatch a caller-assembled batch in max_batch slices so one giant
+    request cannot balloon the engine's working set past what every other
+    path is capped at. Shared by every batch-transport checker."""
+    out: list[bool] = []
+    for i in range(0, len(requests), max_batch):
+        out.extend(
+            bool(v)
+            for v in engine.batch_check(
+                requests[i : i + max_batch], max_depth
+            )
+        )
+    return out

@@ -455,8 +455,9 @@ class ClosureCheckEngine:
         pn = snap.padded_nodes
         dummy = snap.dummy_node
 
-        # ---- encode: two C-speed map() passes per side
-        get = snap.vocab._id_of.get
+        # ---- encode: vectorized hash-index lookups (vocab.lookup_bulk);
+        # at tens of millions of vocab entries the dict-probe chain is the
+        # batch's dominant cost
         skeys = [(r.namespace, r.object, r.relation) for r in requests]
         tkeys = [
             (s.id,)
@@ -464,20 +465,10 @@ class ClosureCheckEngine:
             else (s.namespace, s.object, s.relation)
             for s in (r.subject for r in requests)
         ]
-        start = np.array(
-            [
-                dummy if v is None or v >= pn else v
-                for v in map(get, skeys)
-            ],
-            dtype=np.int64,
-        )
-        target = np.array(
-            [
-                dummy if v is None or v >= pn else v
-                for v in map(get, tkeys)
-            ],
-            dtype=np.int64,
-        )
+        s_ids = snap.vocab.lookup_bulk(skeys)
+        t_ids = snap.vocab.lookup_bulk(tkeys)
+        start = np.where((s_ids < 0) | (s_ids >= pn), dummy, s_ids)
+        target = np.where((t_ids < 0) | (t_ids >= pn), dummy, t_ids)
         is_id = np.fromiter(
             (len(k) == 1 for k in tkeys), dtype=bool, count=n
         )

@@ -175,9 +175,8 @@ class ShardedCheckEngine:
             return []
         snap = self.snapshots.snapshot()
         n = len(requests)
-        # encode with the same two C-speed map() passes the closure engine
-        # uses — no per-request Python attribute chasing in the hot loop
-        get = snap.vocab._id_of.get
+        # encode via the vocab's vectorized hash index (same path as the
+        # closure engine) — no per-request Python in the hot loop
         pn = snap.padded_nodes
         dummy = snap.dummy_node
         skeys = [(r.namespace, r.object, r.relation) for r in requests]
@@ -186,14 +185,10 @@ class ShardedCheckEngine:
             else (s.namespace, s.object, s.relation)
             for s in (r.subject for r in requests)
         ]
-        start = np.array(
-            [dummy if v is None or v >= pn else v for v in map(get, skeys)],
-            dtype=np.int64,
-        )
-        target = np.array(
-            [dummy if v is None or v >= pn else v for v in map(get, tkeys)],
-            dtype=np.int64,
-        )
+        s_ids = snap.vocab.lookup_bulk(skeys)
+        t_ids = snap.vocab.lookup_bulk(tkeys)
+        start = np.where((s_ids < 0) | (s_ids >= pn), dummy, s_ids)
+        target = np.where((t_ids < 0) | (t_ids >= pn), dummy, t_ids)
         if depths is not None:
             want = np.asarray(depths, dtype=np.int32)
         else:

@@ -32,10 +32,13 @@ from keto_tpu.driver import Config, Registry
 
 
 class ServerFixture:
-    """Runs a Registry's planes in a background asyncio loop thread."""
+    """Runs a Registry's planes in a background asyncio loop thread.
+    Accepts a Config or a pre-built Registry (factory-made)."""
 
-    def __init__(self, config: Config):
-        self.registry = Registry(config)
+    def __init__(self, config: Config | Registry):
+        self.registry = (
+            config if isinstance(config, Registry) else Registry(config)
+        )
         self.loop = asyncio.new_event_loop()
         self.thread = threading.Thread(target=self.loop.run_forever, daemon=True)
         self.thread.start()

@@ -22,17 +22,7 @@ from tests.test_api_server import ServerFixture
 
 @pytest.fixture(scope="module")
 def server():
-    reg = new_test_registry(namespaces=("videos",))
-    s = ServerFixture.__new__(ServerFixture)
-    import asyncio
-    import threading
-
-    s.registry = reg
-    s.loop = asyncio.new_event_loop()
-    s.thread = threading.Thread(target=s.loop.run_forever, daemon=True)
-    s.thread.start()
-    fut = asyncio.run_coroutine_threadsafe(reg.start_all(), s.loop)
-    s.read_port, s.write_port = fut.result(timeout=180)
+    s = ServerFixture(new_test_registry(namespaces=("videos",)))
     yield s
     s.stop()
 
@@ -118,6 +108,17 @@ class TestGrpcClient:
                 SubjectSet(namespace="videos", object="/d", relation="view")
             )
             assert tree is not None
+
+    def test_grpc_batch_check(self, server, rest):
+        rest.create_relation_tuple("videos:/b#view@eve")
+        with GrpcClient(f"127.0.0.1:{server.read_port}") as g:
+            assert g.batch_check(
+                [
+                    "videos:/b#view@eve",
+                    "videos:/b#view@nobody",
+                    "videos:/b#view@eve",
+                ]
+            ) == [True, False, True]
 
 
 class TestRegistryFactories:

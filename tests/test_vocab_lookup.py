@@ -1,0 +1,92 @@
+"""NodeVocab.lookup_bulk: the vectorized hash-index encode path must agree
+exactly with the dict, across growth, rebuilds, unknowns, and forced
+64-bit hash collisions."""
+
+import numpy as np
+import pytest
+
+from keto_tpu.graph.vocab import NodeVocab
+
+
+def _keys(n, prefix="k"):
+    return [(f"{prefix}{i}", f"o{i}", "r") for i in range(n)]
+
+
+class TestLookupBulk:
+    def test_matches_dict_with_unknowns(self):
+        v = NodeVocab()
+        keys = _keys(500) + [(f"u{i}",) for i in range(100)]
+        v.intern_bulk(keys)
+        probe = keys[::3] + _keys(50, prefix="missing") + [("nope",)]
+        got = v.lookup_bulk(probe)
+        expect = [
+            v.lookup(k) if v.lookup(k) is not None else -1 for k in probe
+        ]
+        assert got.tolist() == expect
+
+    def test_incremental_growth_and_rebuild(self):
+        v = NodeVocab()
+        v.intern_bulk(_keys(10))
+        assert v.lookup_bulk([("k3", "o3", "r")]).tolist() == [
+            v.lookup(("k3", "o3", "r"))
+        ]
+        # grow far past the first table size: forces a from-scratch rebuild
+        v.intern_bulk(_keys(5000, prefix="x"))
+        probe = [("x4999", "o4999", "r"), ("k3", "o3", "r"), ("gone",)]
+        assert v.lookup_bulk(probe).tolist() == [
+            v.lookup(probe[0]),
+            v.lookup(probe[1]),
+            -1,
+        ]
+
+    def test_forced_hash_collisions_detected_on_insert(self):
+        """Different keys, identical 64-bit hash: every colliding hash must
+        land in the collision set so lookups route through the exact dict
+        (only the first key of a colliding group lives in the table)."""
+        v = NodeVocab()
+        keys = _keys(64)
+        v.intern_bulk(keys)
+        # build a degraded index where EVERY key hashes to 42
+        n = len(v._key_of)
+        need = 1 << int(n / 0.6).bit_length()
+        mask = need - 1
+        slots = np.zeros(need, dtype=np.int64)
+        slot_ids = np.full(need, -1, dtype=np.int32)
+        collisions: set = set()
+        all_h = np.full(n, 42, dtype=np.int64)
+        NodeVocab._insert_hashes(
+            mask, slots, slot_ids, collisions, all_h,
+            np.arange(n, dtype=np.int32),
+        )
+        assert collisions == {42}
+        # exactly one entry made it into the table (the rest must use the
+        # dict): the winning slot holds a valid id
+        stored = slot_ids[slot_ids >= 0]
+        assert len(stored) == 1 and 0 <= stored[0] < n
+
+    def test_empty(self):
+        v = NodeVocab()
+        assert v.lookup_bulk([]).tolist() == []
+        assert v.lookup_bulk([("a",)]).tolist() == [-1]
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_interleaved_intern_lookup(self, seed):
+        rng = np.random.default_rng(seed)
+        v = NodeVocab()
+        universe = _keys(2000) + [(f"s{i}",) for i in range(800)]
+        for _ in range(6):
+            batch = [
+                universe[i]
+                for i in rng.integers(len(universe), size=300)
+            ]
+            v.intern_bulk(batch)
+            probe = [
+                universe[i]
+                for i in rng.integers(len(universe), size=200)
+            ]
+            got = v.lookup_bulk(probe)
+            expect = [
+                v.lookup(k) if v.lookup(k) is not None else -1
+                for k in probe
+            ]
+            assert got.tolist() == expect
