@@ -76,13 +76,24 @@ def cli(ctx, read_remote, write_remote):
 
 @cli.command()
 @click.option("--config", "-c", "config_file", default=None, type=click.Path())
+@click.option(
+    "--profile-out", default="keto_profile.out", show_default=True,
+    help="where `profiling: cpu` writes its pstats dump on shutdown",
+)
 @click.pass_context
-def serve(ctx, config_file):
+def serve(ctx, config_file, profile_out):
     """Start the read (:4466) and write (:4467) servers
-    (reference cmd/server/serve.go)."""
+    (reference cmd/server/serve.go). With `profiling: cpu` in the config,
+    the serve lifetime's MAIN THREAD (the asyncio event loop: REST
+    routing, the mux, handler dispatch) runs under cProfile and dumps
+    pstats on shutdown (reference main.go:24 profilex wrapper +
+    `profiling` key). cProfile is per-thread, so work on gRPC/executor
+    worker threads is not captured — profile engine internals directly
+    via bench.py or the tracing spans instead."""
     from ..driver import Config, Registry
 
-    registry = Registry(Config(config_file=config_file))
+    config = Config(config_file=config_file)
+    registry = Registry(config)
 
     async def _run():
         loop = asyncio.get_running_loop()
@@ -96,7 +107,19 @@ def serve(ctx, config_file):
         click.echo("shutting down gracefully...")
         await registry.stop_all()
 
-    asyncio.run(_run())
+    if str(config.get("profiling", default="") or "") == "cpu":
+        import cProfile
+
+        profiler = cProfile.Profile()
+        profiler.enable()
+        try:
+            asyncio.run(_run())
+        finally:
+            profiler.disable()
+            profiler.dump_stats(profile_out)
+            click.echo(f"cpu profile written to {profile_out}")
+    else:
+        asyncio.run(_run())
 
 
 # -- check / expand ------------------------------------------------------------
