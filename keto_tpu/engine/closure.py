@@ -575,6 +575,17 @@ class ClosureCheckEngine:
     ) -> np.ndarray:
         n = len(start)
         ig = art.ig
+        # process rows sorted by start id: requests sharing a start (or
+        # nearby starts) then gather the same F0/indptr/closure rows
+        # back-to-back, which turns the batch's random walk over the
+        # hundreds-of-MB closure/CSR arrays into mostly-cached re-reads —
+        # measured ~3x on the 30M-tuple array path. Results are scattered
+        # back to request order at the end.
+        order = np.argsort(start, kind="stable")
+        start = start[order]
+        target = target[order]
+        is_id = is_id[order]
+        depth = depth[order]
         direct = ig.direct_edge(start, target)
 
         # split by fan-out: one hot row (a user in 30 groups) would
@@ -615,7 +626,8 @@ class ClosureCheckEngine:
             fb = self.fallback_engine()
             idxs = np.nonzero(overflow)[0]
             if requests is not None:
-                over_reqs = [requests[i] for i in idxs]
+                # idxs index the SORTED rows; requests are request-ordered
+                over_reqs = [requests[order[i]] for i in idxs]
             else:
                 over_reqs = self._decode_requests(
                     snap, start[idxs], target[idxs]
@@ -625,7 +637,9 @@ class ClosureCheckEngine:
             )
             for i, v in zip(idxs, res):
                 allowed[i] = v
-        return allowed
+        out = np.empty(n, dtype=bool)
+        out[order] = allowed
+        return out
 
     def _query_rows(
         self, art, ig, start, target, is_id, depth, direct

@@ -106,15 +106,18 @@ class ColumnarTupleStore(Manager):
             "dst_node": np.empty(cap, np.int32),
             "alive": np.empty(cap, bool),
         }
-        # row lookup for dedup/delete: (src_node << 32 | dst_node) -> row
-        # index (packed int keys so point paths can use C-speed map()).
-        # LAZY after bulk loads: building a 100M-entry dict costs minutes
-        # and the graph/serving path never reads it — per-tuple write/
-        # delete rebuilds it on demand (_ensure_row_index); bulk dedup
-        # meanwhile uses sorted key arrays (_key_chunks).
+        # Row lookup for dedup/delete, two tiers that together cover every
+        # live row WITHOUT ever materializing a 100M-entry dict (which
+        # would stall the first point write after a bulk load for
+        # minutes):
+        # - _row_of: overlay dict for rows added by point writes;
+        # - _key_chunks: per-bulk-load (sorted keys, rows in key order)
+        #   pairs — point lookups binary-search each chunk (compacted when
+        #   the list grows).
+        # A key found in either tier still checks the alive column
+        # (tombstones stay in the chunks).
         self._row_of: dict[int, int] = {}
-        self._row_index_dirty = False
-        self._key_chunks: list[np.ndarray] = []  # sorted int64, per bulk load
+        self._key_chunks: list[tuple[np.ndarray, np.ndarray]] = []
         # node id -> string-pool ids, extended lazily as the vocab grows;
         # -1 marks "not applicable" (sid for set keys, ns/obj/rel for id
         # keys). Lets bulk loads derive per-row columns by fancy indexing
@@ -221,43 +224,63 @@ class ColumnarTupleStore(Manager):
             subject=subject,
         )
 
-    def _row_keys(self) -> np.ndarray:
-        n = self._n
-        return (
-            self._cols["src_node"][:n].astype(np.int64) << 32
-        ) | self._cols["dst_node"][:n].astype(np.int64)
+    def _row_for_key(self, key: int) -> Optional[int]:
+        """Row index currently holding `key` (alive or tombstoned), or
+        None. Row indices are append-ordered in time, so the CURRENT owner
+        is the maximum row across the overlay dict and every bulk chunk —
+        a deleted key can be re-added by either tier in any order."""
+        best = self._row_of.get(key, -1)
+        for chunk_keys, chunk_rows in self._key_chunks:
+            pos = int(np.searchsorted(chunk_keys, key))
+            if pos < len(chunk_keys) and chunk_keys[pos] == key:
+                best = max(best, int(chunk_rows[pos]))
+        return None if best < 0 else best
 
-    def _ensure_row_index(self) -> None:
-        """Rebuild the point-lookup dict after bulk loads left it stale.
-        Once rebuilt the dict is authoritative and the bulk key chunks are
-        dropped (they may contain keys of since-deleted rows)."""
-        if not self._row_index_dirty:
-            return
-        keys = self._row_keys()
-        alive_rows = np.nonzero(self._cols["alive"][: self._n])[0]
-        self._row_of = dict(
-            zip(keys[alive_rows].tolist(), alive_rows.tolist())
-        )
-        self._key_chunks = []
-        self._row_index_dirty = False
+    def _alive_row_for_key(self, key: int) -> Optional[int]:
+        row = self._row_for_key(key)
+        if row is not None and self._cols["alive"][row]:
+            return row
+        return None
 
     def _bulk_existing(self, keys: np.ndarray) -> np.ndarray:
-        """bool[n]: key already present? Union of the point dict (always
-        valid for the rows it covers) and the bulk-loaded sorted chunks."""
-        mask = np.zeros(len(keys), dtype=bool)
+        """bool[n]: key currently LIVE? Union of the overlay dict and the
+        bulk chunks, with tombstones filtered through the alive column."""
+        n = len(keys)
+        rows = np.full(n, -1, dtype=np.int64)
         if self._row_of:
-            mask |= np.fromiter(
-                map(self._row_of.__contains__, keys.tolist()),
-                dtype=bool,
-                count=len(keys),
+            got = list(map(self._row_of.get, keys.tolist()))
+            rows = np.array(
+                [r if r is not None else -1 for r in got], dtype=np.int64
             )
-        for chunk in self._key_chunks:
-            pos = np.searchsorted(chunk, keys)
-            in_range = pos < len(chunk)
-            hit = np.zeros(len(keys), dtype=bool)
-            hit[in_range] = chunk[pos[in_range]] == keys[in_range]
-            mask |= hit
+        for chunk_keys, chunk_rows in self._key_chunks:
+            pos = np.searchsorted(chunk_keys, keys)
+            in_range = pos < len(chunk_keys)
+            hit = np.zeros(n, dtype=bool)
+            hit[in_range] = chunk_keys[pos[in_range]] == keys[in_range]
+            cand = np.where(hit, chunk_rows[np.minimum(pos, len(chunk_rows) - 1)], -1)
+            rows = np.maximum(rows, cand)
+        mask = rows >= 0
+        mask[mask] = self._cols["alive"][rows[mask]]
         return mask
+
+    def _compact_chunks(self) -> None:
+        """Tiered merge when the chunk list grows: point lookups do one
+        binary search per chunk, so keep the count bounded — but only the
+        SMALLEST chunks merge (LSM-style), so streaming ingest in many
+        batches pays amortized O(N log N), not a full re-sort of the
+        accumulated set every 33rd load. Duplicate keys (re-added after
+        deletion) keep only their HIGHEST row — the current owner."""
+        if len(self._key_chunks) <= 32:
+            return
+        self._key_chunks.sort(key=lambda c: len(c[0]), reverse=True)
+        small = [self._key_chunks.pop() for _ in range(16)]
+        keys = np.concatenate([c[0] for c in small])
+        rows = np.concatenate([c[1] for c in small])
+        order = np.lexsort((rows, keys))
+        keys = keys[order]
+        rows = rows[order]
+        last = np.append(keys[1:] != keys[:-1], True)
+        self._key_chunks.append((keys[last], rows[last]))
 
     def _ensure_derived(self) -> None:
         """Materialize the per-row string-pool columns bulk loads defer
@@ -282,13 +305,11 @@ class ColumnarTupleStore(Manager):
 
     def _insert_locked(self, t: RelationTuple) -> Optional[RelationTuple]:
         """Insert one tuple; returns it when fresh, None when duplicate."""
-        self._ensure_row_index()
         self._ensure_capacity(1)
         row = self._n
         src, dst = self._encode_row(t, row)
         key = (src << 32) | dst
-        existing = self._row_of.get(key)
-        if existing is not None and self._cols["alive"][existing]:
+        if self._alive_row_for_key(key) is not None:
             return None  # idempotent duplicate
         self._row_of[key] = row
         self._n += 1
@@ -298,18 +319,17 @@ class ColumnarTupleStore(Manager):
         return t
 
     def _delete_locked(self, t: RelationTuple) -> Optional[RelationTuple]:
-        self._ensure_row_index()
         src = self.vocab.lookup(set_key(t.namespace, t.object, t.relation))
         dst = self.vocab.lookup(subject_node_key(t.subject))
         if src is None or dst is None:
             return None
         key = (src << 32) | dst
-        row = self._row_of.get(key)
-        if row is None or not self._cols["alive"][row]:
+        row = self._alive_row_for_key(key)
+        if row is None:
             return None
         self._cols["alive"][row] = False
         self._live -= 1
-        del self._row_of[key]
+        self._row_of.pop(key, None)  # chunk entries tombstone via `alive`
         return t
 
     def _query_mask(self, query: RelationQuery) -> np.ndarray:
@@ -394,7 +414,6 @@ class ColumnarTupleStore(Manager):
 
     def delete_all_relation_tuples(self, query: RelationQuery) -> None:
         with self._lock:
-            self._ensure_row_index()
             rows = np.nonzero(self._query_mask(query))[0]
             gone = [self._decode_row(int(r)) for r in rows]
             self._cols["alive"][rows] = False
@@ -402,7 +421,7 @@ class ColumnarTupleStore(Manager):
             c = self._cols
             for r in rows:
                 key = (int(c["src_node"][r]) << 32) | int(c["dst_node"][r])
-                self._row_of.pop(key, None)
+                self._row_of.pop(key, None)  # chunks tombstone via `alive`
             self._version += 1
             v = self._version
         self._notify(v, deleted=gone)
@@ -494,14 +513,22 @@ class ColumnarTupleStore(Manager):
                 sl = slice(n0, n0 + n_new)
                 c = self._cols
                 # only the graph columns are written here; the per-row
-                # string columns and the point-lookup dict materialize
-                # lazily (_ensure_derived / _ensure_row_index) — at 100M
-                # rows they cost minutes the serving path never repays
+                # string columns materialize lazily (_ensure_derived) and
+                # point lookups go through the sorted key chunks — at 100M
+                # rows an eager dict/column fill costs minutes the serving
+                # path never repays
                 c["src_node"][sl] = src_ids
                 c["dst_node"][sl] = dst_ids
                 c["alive"][sl] = True
-                self._key_chunks.append(np.sort(keys_all[take]))
-                self._row_index_dirty = True
+                new_keys = keys_all[take]
+                order = np.argsort(new_keys)
+                self._key_chunks.append(
+                    (
+                        new_keys[order],
+                        (n0 + order).astype(np.int64),
+                    )
+                )
+                self._compact_chunks()
                 self._n += n_new
                 self._live += n_new
             self._version += 1
