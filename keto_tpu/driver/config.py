@@ -154,6 +154,9 @@ CONFIG_SCHEMA = {
                 "interior_limit": {"type": "integer", "minimum": 2},
                 "query_mode": {"enum": ["auto", "host", "device"]},
                 "freshness": {"enum": ["auto", "strong", "bounded"]},
+                # single-check LRU result cache entries (0 disables); the
+                # cache empties whenever the served version advances
+                "cache_size": {"type": "integer", "minimum": 0},
                 "strong_freshness_edges": {"type": "integer", "minimum": 0},
                 "rebuild_debounce_ms": {"type": "number", "minimum": 0},
                 "mesh": {
@@ -192,6 +195,7 @@ DEFAULTS = {
     "engine.freshness": "auto",
     "engine.strong_freshness_edges": 1 << 21,
     "engine.rebuild_debounce_ms": 50,
+    "engine.cache_size": 65536,
     "engine.mesh.data": 1,
     "engine.mesh.edge": 0,
 }

@@ -235,12 +235,22 @@ class Registry:
             else:
                 # device-backed engines (frontier/closure/sharded) amortize
                 # per-batch costs — route through the batching seam
+                cache_size = int(self.config.get("engine.cache_size"))
+                cache = None
+                if cache_size > 0:
+                    from ..engine.cache import CheckResultCache
+
+                    cache = CheckResultCache(
+                        capacity=cache_size, metrics=self.metrics()
+                    )
                 self._batcher = CheckBatcher(
                     engine,
                     max_batch=int(self.config.get("engine.max_batch")),
                     window_s=float(self.config.get("engine.batch_window_us"))
                     / 1e6,
                     metrics=self.metrics(),
+                    cache=cache,
+                    version_fn=self._answering_version,
                 )
                 self._checker = self._batcher
         return self._checker
@@ -249,15 +259,28 @@ class Registry:
         """Write-plane snaptoken: the store's durable version."""
         return str(self.store().version)
 
+    def _served_version(self) -> int:
+        """The version checks are actually answered at (engine-served
+        under bounded freshness, else the store's)."""
+        engine = self.check_engine()
+        served = getattr(engine, "served_version", None)
+        if served is not None:
+            return served()
+        return self.store().version
+
+    def _answering_version(self) -> int:
+        """The version the NEXT check will answer at — the cache stamp."""
+        engine = self.check_engine()
+        answering = getattr(engine, "answering_version", None)
+        if answering is not None:
+            return answering()
+        return self.store().version
+
     def read_snaptoken(self) -> str:
         """Read-plane snaptoken: the version checks are actually answered
         at. Under bounded freshness the engine may serve a slightly older
         snapshot while a rebuild runs; the token names that snapshot."""
-        engine = self.check_engine()
-        served = getattr(engine, "served_version", None)
-        if served is not None:
-            return str(served())
-        return self.snaptoken()
+        return str(self._served_version())
 
     # -- serving ---------------------------------------------------------------
 

@@ -26,10 +26,14 @@ class CheckBatcher:
         max_batch: int = 4096,
         window_s: float = 0.0002,
         metrics=None,
+        cache=None,  # CheckResultCache; None disables
+        version_fn=None,  # served-version supplier for cache stamping
     ):
         self.engine = engine
         self.max_batch = max_batch
         self.window_s = window_s
+        self.cache = cache
+        self.version_fn = version_fn
         self._m_batch_size = (
             metrics.histogram(
                 "keto_batcher_batch_size",
@@ -51,13 +55,22 @@ class CheckBatcher:
     def check(
         self, request: RelationTuple, max_depth: int = 0, timeout: Optional[float] = None
     ) -> bool:
+        if self.cache is not None:
+            version = self.version_fn()
+            key = (request, max_depth)
+            cached = self.cache.get(version, key)
+            if cached is not None:
+                return cached
         f: Future = Future()
         with self._cv:
             if self._closed:
                 raise RuntimeError("batcher closed")
             self._queue.append((request, max_depth, f))
             self._cv.notify()
-        return f.result(timeout=timeout)
+        result = f.result(timeout=timeout)
+        if self.cache is not None:
+            self.cache.put(version, key, result)
+        return result
 
     def check_batch(
         self, requests: Sequence[RelationTuple], max_depth: int = 0

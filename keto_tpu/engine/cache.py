@@ -1,0 +1,73 @@
+"""Check-result cache: version-stamped LRU over single-check answers.
+
+The reference lists caching among planned-but-unimplemented features
+(reference docs/docs/implemented-planned-features.mdx:30-34). Here it is
+real: hot single-check RPCs (the same user hitting the same object) skip
+the engine entirely.
+
+Correctness: entries are stamped with the engine's ANSWERING version
+(ClosureCheckEngine.answering_version) — the version the next check would
+be computed at. Under strong freshness that is the store version (so a
+write instantly invalidates, even though the serving state still names the
+old version until the rebuild runs); under bounded freshness it is the
+serving snapshot's version, and asking for it also kicks the background
+rebuild so cache hits cannot starve the freshness machinery. Do NOT stamp
+with served_version: it lags writes under strong freshness and would keep
+returning pre-write answers. Batch paths bypass the cache (they are
+already amortized; per-item lookups would just add lock traffic).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Hashable, Optional
+
+
+class CheckResultCache:
+    def __init__(self, capacity: int = 65536, metrics=None):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[Hashable, bool] = OrderedDict()
+        self._version: Optional[int] = None
+        if metrics is not None:
+            self._m_hits = metrics.counter(
+                "keto_check_cache_hits_total", "single-check cache hits"
+            )
+            self._m_misses = metrics.counter(
+                "keto_check_cache_misses_total", "single-check cache misses"
+            )
+        else:
+            self._m_hits = self._m_misses = None
+
+    def get(self, version: int, key: Hashable) -> Optional[bool]:
+        with self._lock:
+            if version != self._version:
+                # data moved: every cached answer is potentially stale
+                self._entries.clear()
+                self._version = version
+                hit = None
+            else:
+                hit = self._entries.get(key)
+                if hit is not None:
+                    self._entries.move_to_end(key)
+        if hit is None:
+            if self._m_misses is not None:
+                self._m_misses.inc()
+            return None
+        if self._m_hits is not None:
+            self._m_hits.inc()
+        return hit
+
+    def put(self, version: int, key: Hashable, value: bool) -> None:
+        with self._lock:
+            if version != self._version:
+                return  # computed against a version we no longer cache
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -247,6 +247,26 @@ class ClosureCheckEngine:
             return state.version
         return self.snapshots.store.version
 
+    def answering_version(self) -> int:
+        """The version the NEXT check will be answered at — what result
+        caches must stamp entries with. Differs from served_version under
+        strong freshness right after a write: the serving state still
+        names the old version, but the next check rebuilds synchronously
+        and answers at the store's; a cache keyed on served_version would
+        keep returning pre-write answers."""
+        state = self._state
+        store_version = self.snapshots.store.version
+        if state is not None and state.version == store_version:
+            return store_version
+        if self._bounded(state) and state is not None:
+            # serving stale while rebuilding — and the rebuild must be
+            # kicked HERE too: a result cache that answers hits without
+            # reaching the engine would otherwise starve the background
+            # rebuild and turn bounded staleness into unbounded
+            self._kick_rebuild()
+            return state.version
+        return store_version  # synchronous rebuild on next check
+
     def _bounded(self, state: Optional[_State]) -> bool:
         if state is None:
             return False  # nothing to serve stale from: must build

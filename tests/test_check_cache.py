@@ -1,0 +1,125 @@
+"""Check-result cache: hot single checks skip the engine; any advance of
+the served version empties the cache (the reference lists caching as
+planned/unimplemented — docs/docs/implemented-planned-features.mdx:30-34)."""
+
+from keto_tpu.driver.factory import new_test_registry
+from keto_tpu.engine.cache import CheckResultCache
+from keto_tpu.relationtuple import RelationTuple
+
+
+def t(s: str) -> RelationTuple:
+    return RelationTuple.from_string(s)
+
+
+class _CountingEngine:
+    """Spy wrapping an engine, counting batch_check invocations."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def batch_check(self, *a, **kw):
+        self.calls += 1
+        return self.inner.batch_check(*a, **kw)
+
+
+class TestCacheUnit:
+    def test_lru_eviction_and_version_clear(self):
+        c = CheckResultCache(capacity=2)
+        c.put(1, "a", True)  # version mismatch before any get: dropped
+        assert c.get(1, "a") is None  # also pins version 1
+        c.put(1, "a", True)
+        c.put(1, "b", False)
+        assert c.get(1, "a") is True
+        c.put(1, "c", True)  # evicts LRU ("b": "a" was touched)
+        assert c.get(1, "b") is None
+        assert c.get(1, "a") is True
+        # version advance clears everything
+        assert c.get(2, "a") is None
+        assert len(c) == 0
+
+
+class TestCacheServing:
+    def test_hot_check_skips_engine_until_write(self):
+        reg = new_test_registry(namespaces=("videos",))
+        store = reg.store()
+        store.write_relation_tuples(t("videos:o#r@alice"))
+        checker = reg.checker()
+        spy = _CountingEngine(reg.check_engine())
+        checker.engine = spy
+
+        assert checker.check(t("videos:o#r@alice"), 0) is True
+        calls_after_first = spy.calls
+        for _ in range(5):
+            assert checker.check(t("videos:o#r@alice"), 0) is True
+        assert spy.calls == calls_after_first  # all cache hits
+
+        # different depth is a different key
+        checker.check(t("videos:o#r@alice"), 3)
+        assert spy.calls == calls_after_first + 1
+
+        # a write advances the version: cache must empty, fresh answer
+        store.write_relation_tuples(t("videos:o#r@bob"))
+        assert checker.check(t("videos:o#r@bob"), 0) is True
+        assert spy.calls > calls_after_first + 1
+        reg._batcher.close()
+
+    def test_delete_invalidates_cached_allow(self):
+        reg = new_test_registry(namespaces=("videos",))
+        store = reg.store()
+        store.write_relation_tuples(t("videos:o#r@alice"))
+        checker = reg.checker()
+        assert checker.check(t("videos:o#r@alice"), 0) is True
+        store.delete_relation_tuples(t("videos:o#r@alice"))
+        assert checker.check(t("videos:o#r@alice"), 0) is False
+        reg._batcher.close()
+
+    def test_cache_metrics_exposed(self):
+        reg = new_test_registry(namespaces=("videos",))
+        reg.store().write_relation_tuples(t("videos:o#r@alice"))
+        checker = reg.checker()
+        checker.check(t("videos:o#r@alice"), 0)
+        checker.check(t("videos:o#r@alice"), 0)
+        text = reg.metrics().expose()
+        assert "keto_check_cache_hits_total 1" in text
+        reg._batcher.close()
+
+    def test_bounded_freshness_cache_hits_do_not_starve_rebuild(self):
+        """Under bounded freshness a cached allow must still converge
+        after a revoking write even if every request hits the cache —
+        answering_version kicks the background rebuild on staleness."""
+        import time
+
+        reg = new_test_registry(
+            namespaces=("videos",),
+            values={
+                "engine": {"freshness": "bounded", "rebuild_debounce_ms": 0}
+            },
+        )
+        store = reg.store()
+        store.write_relation_tuples(t("videos:o#r@alice"))
+        checker = reg.checker()
+        assert checker.check(t("videos:o#r@alice"), 0) is True
+        store.delete_relation_tuples(t("videos:o#r@alice"))
+        deadline = time.monotonic() + 15
+        got = True
+        while time.monotonic() < deadline:
+            got = checker.check(t("videos:o#r@alice"), 0)
+            if got is False:
+                break
+            time.sleep(0.02)
+        assert got is False
+        reg._batcher.close()
+
+    def test_cache_disabled_by_config(self):
+        reg = new_test_registry(
+            namespaces=("videos",), values={"engine": {"cache_size": 0}}
+        )
+        reg.store().write_relation_tuples(t("videos:o#r@alice"))
+        checker = reg.checker()
+        assert checker.cache is None
+        assert checker.check(t("videos:o#r@alice"), 0) is True
+        reg._batcher.close()
