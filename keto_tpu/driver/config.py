@@ -155,7 +155,9 @@ CONFIG_SCHEMA = {
                 "query_mode": {"enum": ["auto", "host", "device"]},
                 "freshness": {"enum": ["auto", "strong", "bounded"]},
                 # single-check LRU result cache entries (0 disables); the
-                # cache empties whenever the served version advances
+                # cache empties whenever the ANSWERING version advances
+                # (engine.answering_version — NOT served_version, which
+                # lags writes under strong freshness)
                 "cache_size": {"type": "integer", "minimum": 0},
                 "strong_freshness_edges": {"type": "integer", "minimum": 0},
                 "rebuild_debounce_ms": {"type": "number", "minimum": 0},

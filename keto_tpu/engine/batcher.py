@@ -55,6 +55,10 @@ class CheckBatcher:
     def check(
         self, request: RelationTuple, max_depth: int = 0, timeout: Optional[float] = None
     ) -> bool:
+        if self._closed:
+            # closed means rebuilds stopped: cached answers could no
+            # longer be invalidated, so they must not be served either
+            raise RuntimeError("batcher closed")
         if self.cache is not None:
             version = self.version_fn()
             key = (request, max_depth)

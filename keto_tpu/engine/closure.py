@@ -258,14 +258,16 @@ class ClosureCheckEngine:
         store_version = self.snapshots.store.version
         if state is not None and state.version == store_version:
             return store_version
-        if self._bounded(state) and state is not None:
+        if self._bounded(state) and isinstance(state, _ClosureArtifacts):
             # serving stale while rebuilding — and the rebuild must be
             # kicked HERE too: a result cache that answers hits without
             # reaching the engine would otherwise starve the background
-            # rebuild and turn bounded staleness into unbounded
+            # rebuild and turn bounded staleness into unbounded.
+            # (_TooBig states are excluded: their fallback answers come
+            # from the LIVE store, so they stamp store_version below.)
             self._kick_rebuild()
             return state.version
-        return store_version  # synchronous rebuild on next check
+        return store_version  # synchronous rebuild / live-store fallback
 
     def _bounded(self, state: Optional[_State]) -> bool:
         if state is None:

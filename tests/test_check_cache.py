@@ -123,3 +123,46 @@ class TestCacheServing:
         assert checker.cache is None
         assert checker.check(t("videos:o#r@alice"), 0) is True
         reg._batcher.close()
+
+
+def test_toobig_fallback_answers_stamp_live_version():
+    """A snapshot whose interior exceeds the closure limit routes checks
+    to the live-store fallback; cached answers must invalidate on EVERY
+    write (stamp = store version), even under bounded freshness."""
+    reg = new_test_registry(
+        namespaces=("videos",),
+        values={
+            "engine": {
+                "interior_limit": 2,
+                "freshness": "bounded",
+                "rebuild_debounce_ms": 0,
+            }
+        },
+    )
+    store = reg.store()
+    # > 2 interior nodes: closure falls back for the whole snapshot
+    store.write_relation_tuples(
+        t("videos:a#r@(videos:b#r)"),
+        t("videos:b#r@(videos:c#r)"),
+        t("videos:c#r@(videos:d#r)"),
+        t("videos:d#r@alice"),
+    )
+    checker = reg.checker()
+    assert checker.check(t("videos:d#r@alice"), 0) is True
+    store.delete_relation_tuples(t("videos:d#r@alice"))
+    # fallback reads the live store: the revocation must be visible on
+    # the very next check, not after a rebuild window
+    assert checker.check(t("videos:d#r@alice"), 0) is False
+    reg._batcher.close()
+
+
+def test_closed_batcher_refuses_even_cached_keys():
+    import pytest
+
+    reg = new_test_registry(namespaces=("videos",))
+    reg.store().write_relation_tuples(t("videos:o#r@alice"))
+    checker = reg.checker()
+    assert checker.check(t("videos:o#r@alice"), 0) is True
+    reg._batcher.close()
+    with pytest.raises(RuntimeError):
+        checker.check(t("videos:o#r@alice"), 0)
