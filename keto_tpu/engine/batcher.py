@@ -27,7 +27,8 @@ class CheckBatcher:
         window_s: float = 0.0002,
         metrics=None,
         cache=None,  # CheckResultCache; None disables
-        version_fn=None,  # served-version supplier for cache stamping
+        version_fn=None,  # ANSWERING-version supplier for cache stamping
+        # (engine.answering_version — not served_version, which lags writes)
     ):
         self.engine = engine
         self.max_batch = max_batch

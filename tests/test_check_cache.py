@@ -1,5 +1,5 @@
 """Check-result cache: hot single checks skip the engine; any advance of
-the served version empties the cache (the reference lists caching as
+the ANSWERING version empties the cache (the reference lists caching as
 planned/unimplemented — docs/docs/implemented-planned-features.mdx:30-34)."""
 
 from keto_tpu.driver.factory import new_test_registry

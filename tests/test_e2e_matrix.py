@@ -50,7 +50,7 @@ class GrpcAdapter:
         return "" if tree is None else str(tree)
 
     def list_count(self, namespace):
-        from keto_tpu.api import acl_pb2, read_service_pb2
+        from keto_tpu.api import read_service_pb2
 
         total, token = 0, ""
         while True:
@@ -218,11 +218,18 @@ class CliAdapter:
         return res.output
 
     def list_count(self, namespace):
-        res = self._run(
-            ["relation-tuple", "get", "--namespace", namespace,
-             "--format", "json"]
-        )
-        return len(json.loads(res.output)["relation_tuples"])
+        total, token = 0, ""
+        while True:
+            args = ["relation-tuple", "get", "--namespace", namespace,
+                    "--format", "json"]
+            if token:
+                args += ["--page-token", token]
+            res = self._run(args)
+            doc = json.loads(res.output)
+            total += len(doc["relation_tuples"])
+            token = doc.get("next_page_token", "")
+            if not token:
+                return total
 
     def delete_all(self, namespace):
         self._run(
