@@ -74,7 +74,30 @@ class CheckServicer:
                 relation=request.relation,
                 subject=subject,
             )
-            allowed = self.checker.check(tup, request.max_depth)
+            # CheckRequest.snaptoken (at-least-as-fresh) and `latest` are
+            # REAL here — the reference documents both as unimplemented
+            # (check_service.proto:43-80)
+            min_version = 0
+            if request.snaptoken:
+                try:
+                    min_version = int(request.snaptoken)
+                except ValueError:
+                    raise ErrMalformedInput(
+                        f"malformed snaptoken {request.snaptoken!r}"
+                    ) from None
+            if request.latest:
+                min_version = max(min_version, 1 << 62)  # clamps to store
+            # bound any freshness wait by the RPC deadline (capped):
+            # pinning a server thread past the client's own deadline only
+            # wastes it
+            remaining = context.time_remaining()
+            timeout = 30.0 if remaining is None else min(remaining, 30.0)
+            allowed = self.checker.check(
+                tup,
+                request.max_depth,
+                timeout=timeout,
+                min_version=min_version,
+            )
             return check_service_pb2.CheckResponse(
                 allowed=allowed, snaptoken=self.snaptoken_fn()
             )
@@ -449,7 +472,16 @@ class _DirectChecker:
         self.engine = engine
         self.max_batch = max_batch
 
-    def check(self, request: RelationTuple, max_depth: int = 0) -> bool:
+    def check(
+        self,
+        request: RelationTuple,
+        max_depth: int = 0,
+        timeout: Optional[float] = None,
+        min_version: int = 0,
+    ) -> bool:
+        # the direct engines answer from live data (host oracle) or
+        # rebuild synchronously, so any min_version is already satisfied
+        del timeout, min_version
         return self.engine.subject_is_allowed(request, max_depth)
 
     def check_batch(self, requests, max_depth: int = 0) -> list:

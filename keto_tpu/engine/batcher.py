@@ -54,12 +54,26 @@ class CheckBatcher:
         self._thread.start()
 
     def check(
-        self, request: RelationTuple, max_depth: int = 0, timeout: Optional[float] = None
+        self,
+        request: RelationTuple,
+        max_depth: int = 0,
+        timeout: Optional[float] = None,
+        min_version: int = 0,
     ) -> bool:
         if self._closed:
             # closed means rebuilds stopped: cached answers could no
             # longer be invalidated, so they must not be served either
             raise RuntimeError("batcher closed")
+        if min_version > 0:
+            # at-least-as-fresh consistency (CheckRequest.snaptoken): make
+            # the serving snapshot catch up before answering. The cache is
+            # still safe afterward — its stamp is the answering version
+            wait = getattr(self.engine, "wait_for_version", None)
+            if wait is not None:
+                wait(
+                    min_version,
+                    timeout_s=timeout if timeout is not None else 30.0,
+                )
         if self.cache is not None:
             version = self.version_fn()
             key = (request, max_depth)

@@ -199,6 +199,7 @@ class ClosureCheckEngine:
         )
         self._lock = threading.Lock()  # guards _rebuilding
         self._build_lock = threading.Lock()  # serializes state builds
+        self._state_cv = threading.Condition()  # notified on state swap
         self._state: Optional[_State] = None
         self._rebuilding = False
         self._fallback = fallback
@@ -303,6 +304,8 @@ class ClosureCheckEngine:
                 snap = self.snapshots.snapshot()
             state = self._build_state(snap, prev=self._state)
             self._state = state
+            with self._state_cv:
+                self._state_cv.notify_all()  # wake wait_for_version
             return state
 
     def _kick_rebuild(self) -> None:
@@ -455,6 +458,44 @@ class ClosureCheckEngine:
         self, requested: RelationTuple, max_depth: int = 0
     ) -> bool:
         return self.batch_check([requested], max_depth)[0]
+
+    def wait_for_version(
+        self, min_version: int, timeout_s: float = 30.0
+    ) -> None:
+        """Block until checks are answered at >= min_version (clamped to
+        the store's current version) — the at-least-as-fresh half of the
+        Zanzibar zookie contract (CheckRequest.snaptoken, which the
+        reference documents as not implemented). Under strong freshness
+        this returns immediately (the next check rebuilds anyway); under
+        bounded freshness it kicks the background rebuild once and waits
+        on the state-swap condition. Raises ErrUnavailable (503 /
+        UNAVAILABLE — a freshness condition, not a server bug) when the
+        snapshot cannot catch up within the deadline."""
+        from ..utils.errors import ErrUnavailable
+
+        target = min(min_version, self.snapshots.store.version)
+        deadline = time.monotonic() + timeout_s
+        kicked = False
+        while True:
+            state = self._state
+            if state is None or not isinstance(state, _ClosureArtifacts):
+                return  # fallback/first-build paths answer from live data
+            if state.version >= target:
+                return
+            if not self._bounded(state):
+                return  # strong freshness: the check itself rebuilds
+            if not kicked:
+                self._kick_rebuild()
+                kicked = True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ErrUnavailable(
+                    f"snapshot did not reach version {target} within "
+                    f"{timeout_s:.1f}s (serving {state.version})"
+                )
+            with self._state_cv:
+                if self._state is state:  # not yet swapped: sleep on it
+                    self._state_cv.wait(timeout=min(remaining, 1.0))
 
     def batch_check(
         self,

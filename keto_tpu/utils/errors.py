@@ -84,3 +84,12 @@ class ErrInternal(KetoError):
     status_code = 500
     status = "Internal Server Error"
     grpc_code = "INTERNAL"
+
+
+class ErrUnavailable(KetoError):
+    """A freshness/availability condition, not a server bug: e.g. a
+    snaptoken-pinned check whose snapshot could not catch up in time."""
+
+    status_code = 503
+    status = "Service Unavailable"
+    grpc_code = "UNAVAILABLE"

@@ -166,3 +166,72 @@ def test_closed_batcher_refuses_even_cached_keys():
     reg._batcher.close()
     with pytest.raises(RuntimeError):
         checker.check(t("videos:o#r@alice"), 0)
+
+
+def test_snaptoken_consistency_waits_for_rebuild():
+    """gRPC CheckRequest.snaptoken (at-least-as-fresh) is real: under
+    bounded freshness, a check carrying the write's snaptoken must
+    reflect that write, while a plain check may serve the older
+    snapshot (reference documents the field as not implemented,
+    check_service.proto:43-80)."""
+    reg = new_test_registry(
+        namespaces=("videos",),
+        values={
+            "engine": {"freshness": "bounded", "rebuild_debounce_ms": 0}
+        },
+    )
+    store = reg.store()
+    store.write_relation_tuples(t("videos:o#r@alice"))
+    checker = reg.checker()
+    assert checker.check(t("videos:o#r@alice"), 0) is True
+
+    store.write_relation_tuples(t("videos:o#r@bob"))
+    token = store.version
+    # consistency-pinned check: must see bob immediately
+    assert checker.check(t("videos:o#r@bob"), 0, min_version=token) is True
+    reg._batcher.close()
+
+
+def test_grpc_snaptoken_and_latest_fields():
+    import grpc
+
+    from keto_tpu.api import acl_pb2, check_service_pb2
+    from keto_tpu.api.services import CheckServiceStub
+    from tests.test_api_server import ServerFixture
+
+    reg = new_test_registry(
+        namespaces=("videos",),
+        values={
+            "engine": {"freshness": "bounded", "rebuild_debounce_ms": 0}
+        },
+    )
+    s = ServerFixture(reg)
+    try:
+        store = reg.store()
+        store.write_relation_tuples(t("videos:o#r@alice"))
+        with grpc.insecure_channel(f"127.0.0.1:{s.read_port}") as ch:
+            stub = CheckServiceStub(ch)
+
+            def check(sub, **kw):
+                return stub.Check(
+                    check_service_pb2.CheckRequest(
+                        namespace="videos", object="o", relation="r",
+                        subject=acl_pb2.Subject(id=sub), **kw,
+                    )
+                )
+
+            assert check("alice").allowed
+            store.write_relation_tuples(t("videos:o#r@bob"))
+            token = str(store.version)
+            resp = check("bob", snaptoken=token)
+            assert resp.allowed and int(resp.snaptoken) >= int(token)
+            store.write_relation_tuples(t("videos:o#r@carol"))
+            assert check("carol", latest=True).allowed
+            # malformed snaptoken -> INVALID_ARGUMENT
+            import pytest
+
+            with pytest.raises(grpc.RpcError) as e:
+                check("alice", snaptoken="not-a-number")
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        s.stop()
