@@ -13,4 +13,4 @@ relation-tuple graph resident in TPU HBM (keto_tpu/ops, keto_tpu/engine),
 sharded over an ICI device mesh for graphs beyond one chip (keto_tpu/parallel).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
