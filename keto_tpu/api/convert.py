@@ -25,6 +25,37 @@ _NODE_TYPE_TO_PROTO = {
 }
 _NODE_TYPE_FROM_PROTO = {v: k for k, v in _NODE_TYPE_TO_PROTO.items()}
 
+# min_version sentinel for `latest: true` — far above any real store
+# version; wait_for_version clamps it to the store's current version
+LATEST_SENTINEL = 1 << 62
+
+
+def min_version_from(snaptoken: str, latest) -> int:
+    """Shared snaptoken/latest -> minimum-version parsing for BOTH
+    transports (REST query params and gRPC request fields): one sentinel,
+    one error message, no drift. `latest` may be a bool (proto) or a
+    query-param string; unrecognized spellings are a 400, not a silent
+    stale read."""
+    min_version = 0
+    if snaptoken:
+        try:
+            min_version = int(snaptoken)
+        except ValueError:
+            raise ErrMalformedInput(
+                f"malformed snaptoken {snaptoken!r}"
+            ) from None
+    if isinstance(latest, str):
+        val = latest.strip().lower()
+        if val in ("true", "1", "yes"):
+            latest = True
+        elif val in ("", "false", "0", "no"):
+            latest = False
+        else:
+            raise ErrMalformedInput(f"malformed latest flag {latest!r}")
+    if latest:
+        min_version = max(min_version, LATEST_SENTINEL)
+    return min_version
+
 
 def subject_to_proto(s: Subject) -> acl_pb2.Subject:
     if isinstance(s, SubjectID):

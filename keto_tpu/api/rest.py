@@ -43,6 +43,7 @@ from ..relationtuple.definitions import (
 )
 from ..utils.errors import ErrMalformedInput, KetoError
 from ..utils.pagination import PaginationOptions
+from .convert import min_version_from
 
 ROUTE_TUPLES = "/relation-tuples"
 ROUTE_CHECK = "/check"
@@ -195,6 +196,16 @@ def subject_from_query(params, required: bool) -> Optional[Subject]:
     return None
 
 
+def _min_version_from_query(params) -> int:
+    """`snaptoken` (a previously returned token) and `latest` query params
+    on the check routes — the at-least-as-fresh consistency contract, same
+    semantics as the gRPC CheckRequest fields (a keto_tpu extension on
+    REST; the reference exposes neither)."""
+    return min_version_from(
+        params.get("snaptoken", ""), params.get("latest", "")
+    )
+
+
 def max_depth_from_query(params) -> int:
     raw = params.get("max-depth", "0")
     try:
@@ -266,13 +277,16 @@ class ReadAPI:
     async def get_check(self, request: web.Request) -> web.Response:
         p = request.rel_url.query
         tup = _tuple_from_query(p)
-        return await self._check_response(tup, max_depth_from_query(p))
+        return await self._check_response(
+            tup, max_depth_from_query(p), _min_version_from_query(p)
+        )
 
     async def post_check(self, request: web.Request) -> web.Response:
         body = await _json_body(request)
         tup = RelationTuple.from_dict(body)
+        p = request.rel_url.query
         return await self._check_response(
-            tup, max_depth_from_query(request.rel_url.query)
+            tup, max_depth_from_query(p), _min_version_from_query(p)
         )
 
     async def post_check_batch(self, request: web.Request) -> web.Response:
@@ -282,7 +296,9 @@ class ReadAPI:
         with answers in request order, always 200 (per-item allow/deny is
         in the body, unlike the single check's 200/403)."""
         body = await _json_body(request)
-        max_depth = max_depth_from_query(request.rel_url.query)
+        p = request.rel_url.query
+        max_depth = max_depth_from_query(p)
+        min_version = _min_version_from_query(p)
         if isinstance(body, dict):
             items = body.get("tuples")
             max_depth = int(body.get("max_depth", max_depth) or max_depth)
@@ -294,19 +310,25 @@ class ReadAPI:
             )
         tuples = [RelationTuple.from_dict(d) for d in items]
         allowed = await asyncio.get_running_loop().run_in_executor(
-            self.executor, self.checker.check_batch, tuples, max_depth
+            self.executor,
+            lambda: self.checker.check_batch(
+                tuples, max_depth, min_version=min_version
+            ),
         )
         return web.json_response(
             {"allowed": allowed, "snaptoken": self.snaptoken_fn()}
         )
 
     async def _check_response(
-        self, tup: RelationTuple, max_depth: int
+        self, tup: RelationTuple, max_depth: int, min_version: int = 0
     ) -> web.Response:
         # the check blocks on device compute (or the batcher window) — run it
         # off the event loop so concurrent requests accumulate into batches
         allowed = await asyncio.get_running_loop().run_in_executor(
-            self.executor, self.checker.check, tup, max_depth
+            self.executor,
+            lambda: self.checker.check(
+                tup, max_depth, min_version=min_version
+            ),
         )
         # 200 when allowed, 403 when denied — both carry the body
         # (reference check/handler.go:120-139)

@@ -35,6 +35,7 @@ from . import (
     write_service_pb2,
 )
 from .convert import (
+    min_version_from,
     query_from_proto_fields,
     subject_from_proto,
     tree_to_proto,
@@ -77,16 +78,9 @@ class CheckServicer:
             # CheckRequest.snaptoken (at-least-as-fresh) and `latest` are
             # REAL here — the reference documents both as unimplemented
             # (check_service.proto:43-80)
-            min_version = 0
-            if request.snaptoken:
-                try:
-                    min_version = int(request.snaptoken)
-                except ValueError:
-                    raise ErrMalformedInput(
-                        f"malformed snaptoken {request.snaptoken!r}"
-                    ) from None
-            if request.latest:
-                min_version = max(min_version, 1 << 62)  # clamps to store
+            min_version = min_version_from(
+                request.snaptoken, request.latest
+            )
             # bound any freshness wait by the RPC deadline (capped):
             # pinning a server thread past the client's own deadline only
             # wastes it
@@ -125,7 +119,17 @@ class CheckServicer:
                         subject=subject,
                     )
                 )
-            allowed = self.checker.check_batch(tuples, request.max_depth)
+            remaining = context.time_remaining()
+            allowed = self.checker.check_batch(
+                tuples,
+                request.max_depth,
+                min_version=min_version_from(
+                    request.snaptoken, request.latest
+                ),
+                timeout=30.0
+                if remaining is None
+                else min(remaining, 30.0),
+            )
             return check_service_pb2.BatchCheckResponse(
                 allowed=allowed, snaptoken=self.snaptoken_fn()
             )
@@ -484,9 +488,12 @@ class _DirectChecker:
         del timeout, min_version
         return self.engine.subject_is_allowed(request, max_depth)
 
-    def check_batch(self, requests, max_depth: int = 0) -> list:
+    def check_batch(
+        self, requests, max_depth: int = 0, min_version: int = 0
+    ) -> list:
         from ..engine.batcher import dispatch_batched
 
+        del min_version  # direct engines answer from live data
         return dispatch_batched(
             self.engine, requests, max_depth, self.max_batch
         )

@@ -92,10 +92,23 @@ class CheckBatcher:
         return result
 
     def check_batch(
-        self, requests: Sequence[RelationTuple], max_depth: int = 0
+        self,
+        requests: Sequence[RelationTuple],
+        max_depth: int = 0,
+        min_version: int = 0,
+        timeout: Optional[float] = None,
     ) -> list[bool]:
         """A caller-assembled batch: already amortized, so it skips the
-        queue and dispatches directly (the batch-check transport path)."""
+        queue and dispatches directly (the batch-check transport path).
+        `min_version` applies the at-least-as-fresh contract to the whole
+        batch before dispatch, bounded by `timeout` (the RPC deadline)."""
+        if min_version > 0:
+            wait = getattr(self.engine, "wait_for_version", None)
+            if wait is not None:
+                wait(
+                    min_version,
+                    timeout_s=timeout if timeout is not None else 30.0,
+                )
         return dispatch_batched(
             self.engine, requests, max_depth, self.max_batch
         )

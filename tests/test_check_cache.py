@@ -220,7 +220,9 @@ def test_grpc_snaptoken_and_latest_fields():
                     )
                 )
 
-            assert check("alice").allowed
+            # server may have warmed on the empty store: pin the first
+            # check to the write's version (the contract under test)
+            assert check("alice", snaptoken=str(store.version)).allowed
             store.write_relation_tuples(t("videos:o#r@bob"))
             token = str(store.version)
             resp = check("bob", snaptoken=token)
@@ -233,5 +235,73 @@ def test_grpc_snaptoken_and_latest_fields():
             with pytest.raises(grpc.RpcError) as e:
                 check("alice", snaptoken="not-a-number")
             assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        s.stop()
+
+
+def test_rest_snaptoken_and_latest_params():
+    """REST /check honors snaptoken/latest the same way gRPC does (a
+    keto_tpu extension — the reference REST surface has neither)."""
+    import httpx
+
+    from tests.test_api_server import ServerFixture
+
+    reg = new_test_registry(
+        namespaces=("videos",),
+        values={"engine": {"freshness": "bounded", "rebuild_debounce_ms": 0}},
+    )
+    s = ServerFixture(reg)
+    try:
+        store = reg.store()
+        store.write_relation_tuples(t("videos:o#r@alice"))
+        base = f"http://127.0.0.1:{s.read_port}/check"
+
+        def check(sub, **extra):
+            return httpx.get(
+                base,
+                params={
+                    "namespace": "videos", "object": "o", "relation": "r",
+                    "subject_id": sub, **extra,
+                },
+            )
+
+        # server warmed up on the empty store: a PLAIN check may serve
+        # that older snapshot under bounded freshness — pinning with the
+        # write's snaptoken is exactly what forces the catch-up
+        assert check("alice", snaptoken=str(store.version)).status_code == 200
+        store.write_relation_tuples(t("videos:o#r@bob"))
+        token = str(store.version)
+        assert check("bob", snaptoken=token).status_code == 200
+        store.write_relation_tuples(t("videos:o#r@carol"))
+        assert check("carol", latest="true").status_code == 200
+        assert check("alice", snaptoken="junk!").status_code == 400
+    finally:
+        s.stop()
+
+
+def test_batch_consistency_both_transports():
+    """Batch checks honor snaptoken/latest on both transports via the
+    shipped clients (proto BatchCheckRequest fields + REST query params)."""
+    from keto_tpu.client import GrpcClient, RestClient
+    from tests.test_api_server import ServerFixture
+
+    reg = new_test_registry(
+        namespaces=("videos",),
+        values={"engine": {"freshness": "bounded", "rebuild_debounce_ms": 0}},
+    )
+    s = ServerFixture(reg)
+    try:
+        store = reg.store()
+        store.write_relation_tuples(t("videos:o#r@alice"))
+        token = str(store.version)
+        with RestClient(f"http://127.0.0.1:{s.read_port}") as rc:
+            assert rc.batch_check(
+                ["videos:o#r@alice", "videos:o#r@nobody"], snaptoken=token
+            ) == [True, False]
+        store.write_relation_tuples(t("videos:o#r@bob"))
+        with GrpcClient(f"127.0.0.1:{s.read_port}") as gc:
+            assert gc.batch_check(
+                ["videos:o#r@bob", "videos:o#r@nobody"], latest=True
+            ) == [True, False]
     finally:
         s.stop()
