@@ -649,6 +649,20 @@ class ClosureCheckEngine:
         target = target[order]
         is_id = is_id[order]
         depth = depth[order]
+
+        from .. import native
+
+        if native.lib is not None and art.d_host is not None:
+            # fused C kernel: direct-edge probe + true-degree closure
+            # gathers in one prefetch-pipelined pass — exact for every
+            # row (no width caps, hence no oracle fallback on this path)
+            allowed = native.closure_check(
+                art.d_host, ig, start, target, is_id, depth
+            )
+            out = np.empty(n, dtype=bool)
+            out[order] = allowed
+            return out
+
         direct = ig.direct_edge(start, target)
 
         # split by fan-out: one hot row (a user in 30 groups) would

@@ -227,17 +227,28 @@ class NodeVocab:
             return out
         # one consistent snapshot of the index family for the whole probe
         mask, slots, slot_ids, collisions, _upto = table
-        h = np.fromiter((hash(k) for k in keys), dtype=np.int64, count=n)
-        idx = (_mix(h) & np.uint64(mask)).astype(np.int64)
-        active = np.arange(n, dtype=np.int64)
-        while len(active):
-            cur = idx[active]
-            occ = slot_ids[cur]
-            hit = (occ >= 0) & (slots[cur] == h[active])
-            out[active[hit]] = occ[hit]
-            cont = (occ >= 0) & ~hit  # empty slot ends the probe chain
-            active = active[cont]
-            idx[active] = (idx[active] + 1) & mask
+        from .. import native
+
+        if native.lib is not None:
+            # C twins: one hash loop + a prefetched probe (the dict-probe
+            # chain over a multi-hundred-MB table is the encode stage's
+            # dominant cost at 100M-tuple vocab sizes)
+            h = native.object_hashes(keys)
+            out = native.probe_index(slots, slot_ids, mask, h)
+        else:
+            h = np.fromiter(
+                (hash(k) for k in keys), dtype=np.int64, count=n
+            )
+            idx = (_mix(h) & np.uint64(mask)).astype(np.int64)
+            active = np.arange(n, dtype=np.int64)
+            while len(active):
+                cur = idx[active]
+                occ = slot_ids[cur]
+                hit = (occ >= 0) & (slots[cur] == h[active])
+                out[active[hit]] = occ[hit]
+                cont = (occ >= 0) & ~hit  # empty slot ends the probe chain
+                active = active[cont]
+                idx[active] = (idx[active] + 1) & mask
         if collisions:
             get = self._id_of.get
             for i in np.nonzero(np.isin(h, list(collisions)))[0]:
