@@ -518,23 +518,45 @@ class ClosureCheckEngine:
         pn = snap.padded_nodes
         dummy = snap.dummy_node
 
-        # ---- encode: vectorized hash-index lookups (vocab.lookup_bulk);
-        # at tens of millions of vocab entries the dict-probe chain is the
-        # batch's dominant cost
-        skeys = [(r.namespace, r.object, r.relation) for r in requests]
-        tkeys = [
-            (s.id,)
-            if type(s) is SubjectID
-            else (s.namespace, s.object, s.relation)
-            for s in (r.subject for r in requests)
-        ]
-        s_ids = snap.vocab.lookup_bulk(skeys)
-        t_ids = snap.vocab.lookup_bulk(tkeys)
+        # ---- encode: requests -> node ids. Fast path hashes the key
+        # tuples straight off the request objects in one C loop
+        # (native.request_hashes) and probes the vocab's open-addressing
+        # index — no key-tuple materialization at all. Fallback builds the
+        # key tuples and goes through lookup_bulk (same index, Python-side
+        # hashing). At tens of millions of vocab entries this encode stage
+        # is the object path's dominant cost.
+        from .. import native
+
+        if native.lib is not None and native.tuple_hash_ok:
+            hs, ht, is_id = native.request_hashes(requests, SubjectID)
+
+            def skey(i: int):
+                r = requests[i]
+                return (r.namespace, r.object, r.relation)
+
+            def tkey(i: int):
+                s = requests[i].subject
+                if type(s) is SubjectID:
+                    return (s.id,)
+                return (s.namespace, s.object, s.relation)
+
+            s_ids = snap.vocab.lookup_hashes(hs, skey)
+            t_ids = snap.vocab.lookup_hashes(ht, tkey)
+        else:
+            skeys = [(r.namespace, r.object, r.relation) for r in requests]
+            tkeys = [
+                (s.id,)
+                if type(s) is SubjectID
+                else (s.namespace, s.object, s.relation)
+                for s in (r.subject for r in requests)
+            ]
+            s_ids = snap.vocab.lookup_bulk(skeys)
+            t_ids = snap.vocab.lookup_bulk(tkeys)
+            is_id = np.fromiter(
+                (len(k) == 1 for k in tkeys), dtype=bool, count=n
+            )
         start = np.where((s_ids < 0) | (s_ids >= pn), dummy, s_ids)
         target = np.where((t_ids < 0) | (t_ids >= pn), dummy, t_ids)
-        is_id = np.fromiter(
-            (len(k) == 1 for k in tkeys), dtype=bool, count=n
-        )
 
         gmax = self.global_max_depth
         if depths is not None:

@@ -37,7 +37,12 @@ from ..ops.frontier import (
     build_dense_adjacency,
     pick_edge_chunk,
 )
-from ..relationtuple.definitions import RelationTuple, Subject, SubjectSet
+from ..relationtuple.definitions import (
+    RelationTuple,
+    Subject,
+    SubjectID,
+    SubjectSet,
+)
 from .check import DEFAULT_MAX_DEPTH, clamp_depth
 from .tree import Tree, NodeType
 
@@ -280,6 +285,17 @@ class SnapshotExpandEngine:
     tuples -> no node; remaining depth <= 1 -> Leaf; otherwise Union over the
     expansions of each tuple's subject, in store insertion order (the CSR's
     stable sort preserves it).
+
+    Traversal is DFS-preorder like the reference — the visited set's
+    mutation order is observable in which occurrence of a repeated set gets
+    expanded — but the per-node Python work is collapsed: child node ids
+    come straight from the CSR (no per-node vocab dict probes), the visited
+    set is a bool array, and the bottom level of the tree (where every
+    child renders as a Leaf regardless of its own edges) is built in one
+    bulk pass per node instead of one recursive call per child. At
+    100M-tuple scale a wide depth-3 expand is dominated by exactly that
+    bottom level — millions of Leaf constructions — so the interior
+    recursion stays Python while the fan-out pays only object construction.
     """
 
     def __init__(
@@ -293,36 +309,82 @@ class SnapshotExpandEngine:
     ) -> Optional[Tree]:
         depth = clamp_depth(max_depth, self.global_max_depth)
         snap = self.snapshots.snapshot()
-        visited: set[int] = set()
-        return self._expand(snap, subject, depth, visited)
-
-    def _expand(
-        self,
-        snap: GraphSnapshot,
-        subject: Subject,
-        rest_depth: int,
-        visited: set[int],
-    ) -> Optional[Tree]:
         if not isinstance(subject, SubjectSet):
             return Tree(type=NodeType.LEAF, subject=subject)
         nid = snap.vocab.lookup_subject(subject)
-        if nid is None:
-            return None  # set never appears as an object#relation: no tuples
-        if nid in visited:
+        if nid is None or nid >= snap.padded_nodes:
+            # set never appears as an object#relation (or was interned
+            # after this snapshot): no tuples
+            return None
+        visited = np.zeros(snap.padded_nodes, dtype=bool)
+        return self._expand_set(snap, subject, nid, depth, visited)
+
+    def _expand_set(
+        self,
+        snap: GraphSnapshot,
+        subject: SubjectSet,
+        nid: int,
+        rest_depth: int,
+        visited: np.ndarray,
+    ) -> Optional[Tree]:
+        if visited[nid]:
             return None  # cycle suppression (engine.go:42-45)
-        visited.add(nid)
+        visited[nid] = True
         successors = snap.out_neighbors(nid)
         if successors.size == 0:
             return None  # no tuples (engine.go:67-69)
         if rest_depth <= 1:
             return Tree(type=NodeType.LEAF, subject=subject)
+        if rest_depth == 2:
+            return self._union_of_leaves(snap, subject, successors, visited)
+        key_of = snap.vocab._key_of
         children = []
-        for child_nid in successors:
-            child_subject = snap.vocab.subject_of(int(child_nid))
-            child = self._expand(snap, child_subject, rest_depth - 1, visited)
+        for child_nid in successors.tolist():
+            k = key_of[child_nid]
+            if len(k) == 1:
+                children.append(
+                    Tree(type=NodeType.LEAF, subject=SubjectID(id=k[0]))
+                )
+                continue
+            child_subject = SubjectSet(
+                namespace=k[0], object=k[1], relation=k[2]
+            )
+            child = self._expand_set(
+                snap, child_subject, child_nid, rest_depth - 1, visited
+            )
             if child is None:
                 # nil child (visited cycle / set with no tuples) degrades to a
                 # Leaf for that subject, never dropped (engine.go:80-86)
                 child = Tree(type=NodeType.LEAF, subject=child_subject)
             children.append(child)
+        return Tree(type=NodeType.UNION, subject=subject, children=children)
+
+    @staticmethod
+    def _union_of_leaves(
+        snap: GraphSnapshot,
+        subject: SubjectSet,
+        successors: np.ndarray,
+        visited: np.ndarray,
+    ) -> Tree:
+        """The tree's bottom level: with one depth step left every child
+        renders as a Leaf whatever its own edges are, so the whole child
+        loop collapses into bulk Leaf construction. The only recursion side
+        effect to preserve is visited bookkeeping: each not-yet-visited SET
+        child would have been marked before its depth check."""
+        is_set = snap.vocab.is_set_array()
+        flags = is_set[successors]
+        set_ids = successors[flags]
+        if set_ids.size:
+            visited[set_ids] = True
+        leaf = NodeType.LEAF
+        key_of = snap.vocab._key_of
+        children = [
+            Tree(type=leaf, subject=SubjectID(id=k[0]))
+            if len(k) == 1
+            else Tree(
+                type=leaf,
+                subject=SubjectSet(namespace=k[0], object=k[1], relation=k[2]),
+            )
+            for k in map(key_of.__getitem__, successors.tolist())
+        ]
         return Tree(type=NodeType.UNION, subject=subject, children=children)

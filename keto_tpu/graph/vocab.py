@@ -218,27 +218,42 @@ class NodeVocab:
         tens of millions of entries. Concurrent interns may be invisible
         to an in-flight lookup (transient miss -> treated as unknown), the
         same staleness window the snapshot layer already tolerates."""
-        from .interior import _mix
-
-        table = self._extend_hash_index()
         n = len(keys)
-        out = np.full(n, -1, dtype=np.int64)
-        if n == 0 or table is None:
-            return out
-        # one consistent snapshot of the index family for the whole probe
-        mask, slots, slot_ids, collisions, _upto = table
+        if n == 0:
+            return np.full(0, -1, dtype=np.int64)
         from .. import native
 
         if native.lib is not None:
-            # C twins: one hash loop + a prefetched probe (the dict-probe
-            # chain over a multi-hundred-MB table is the encode stage's
-            # dominant cost at 100M-tuple vocab sizes)
+            # C twin: one hash loop (the dict-probe chain over a
+            # multi-hundred-MB table is the encode stage's dominant cost
+            # at 100M-tuple vocab sizes)
             h = native.object_hashes(keys)
-            out = native.probe_index(slots, slot_ids, mask, h)
         else:
             h = np.fromiter(
                 (hash(k) for k in keys), dtype=np.int64, count=n
             )
+        return self.lookup_hashes(h, keys.__getitem__)
+
+    def lookup_hashes(self, h: np.ndarray, key_fn) -> np.ndarray:
+        """int64 ids for keys whose Python hashes are `h`, -1 where unknown.
+        The zero-materialization encode path: callers compute key hashes
+        straight off their request objects (native.request_hashes) and only
+        build an actual key via `key_fn(i)` for the rare rows whose hash
+        collides inside the vocab (exact-dict fallback). Same transient-miss
+        semantics as lookup_bulk."""
+        from .interior import _mix
+
+        table = self._extend_hash_index()
+        n = len(h)
+        out = np.full(n, -1, dtype=np.int64)
+        if n == 0 or table is None:
+            return out
+        mask, slots, slot_ids, collisions, _upto = table
+        from .. import native
+
+        if native.lib is not None:
+            out = native.probe_index(slots, slot_ids, mask, h)
+        else:
             idx = (_mix(h) & np.uint64(mask)).astype(np.int64)
             active = np.arange(n, dtype=np.int64)
             while len(active):
@@ -246,13 +261,13 @@ class NodeVocab:
                 occ = slot_ids[cur]
                 hit = (occ >= 0) & (slots[cur] == h[active])
                 out[active[hit]] = occ[hit]
-                cont = (occ >= 0) & ~hit  # empty slot ends the probe chain
+                cont = (occ >= 0) & ~hit
                 active = active[cont]
                 idx[active] = (idx[active] + 1) & mask
         if collisions:
             get = self._id_of.get
             for i in np.nonzero(np.isin(h, list(collisions)))[0]:
-                v = get(keys[i])
+                v = get(key_fn(int(i)))
                 out[i] = -1 if v is None else v
         return out
 

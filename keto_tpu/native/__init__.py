@@ -120,6 +120,44 @@ def available() -> bool:
     return lib is not None
 
 
+def _tuple_hash_selftest() -> bool:
+    """True when the C tuple-hash combine reproduces this interpreter's
+    hash() for tuples — required before request_hashes may feed the vocab
+    index (which is keyed by Python hashes). 32-bit or future-scheme
+    interpreters fail closed: the fast path is skipped, never wrong."""
+    if lib is None:
+        return False
+    probes = [
+        ("a", "b", "c"),
+        ("", "", ""),
+        ("ns", "obj/with/path", "rel"),
+        ("u123",),
+        (str(0x1234) * 7,),
+    ]
+    try:
+        return all(lib.tuple_hash_check(t) == hash(t) for t in probes)
+    except Exception:
+        return False
+
+
+tuple_hash_ok = _tuple_hash_selftest()
+
+
+def request_hashes(requests, subject_id_type):
+    """(hs int64[n], ht int64[n], is_id bool[n]) straight off RelationTuple
+    objects: hs = hash of the (ns, obj, rel) key, ht = hash of the subject's
+    node key. One C loop — no key-tuple materialization. Callers must have
+    verified tuple_hash_ok."""
+    n = len(requests)
+    hs = np.empty(n, dtype=np.int64)
+    ht = np.empty(n, dtype=np.int64)
+    is_id = np.empty(n, dtype=np.uint8)
+    lib.request_hashes(
+        requests, subject_id_type, _addr(hs), _addr(ht), _addr(is_id)
+    )
+    return hs, ht, is_id.astype(bool)
+
+
 def object_hashes(keys) -> np.ndarray:
     """int64[n] of hash(k) for each key — C loop twin of
     np.fromiter((hash(k) for k in keys), np.int64)."""

@@ -60,6 +60,161 @@ static PyObject *object_hashes(PyObject *self, PyObject *args) {
 }
 
 /* ---------------------------------------------------------------------------
+ * Tuple-hash twins: combine element hashes exactly as CPython's tuplehash
+ * (Objects/tupleobject.c, the xxHash-based scheme, 64-bit variant) so key
+ * hashes computed here probe the same open-addressing index the Python side
+ * builds from hash((ns, obj, rel)). Parity is asserted at import by the
+ * wrapper (native.tuple_hash_selftest); on mismatch the request-encode fast
+ * path is disabled, never wrong.
+ * ------------------------------------------------------------------------ */
+#define XXPRIME_1 ((uint64_t)11400714785074694791ULL)
+#define XXPRIME_2 ((uint64_t)14029467366897019727ULL)
+#define XXPRIME_5 ((uint64_t)2870177450012600261ULL)
+#define XXROTATE(x) (((x) << 31) | ((x) >> 33))
+
+static inline uint64_t tuplehash_lane(uint64_t acc, uint64_t lane) {
+    acc += lane * XXPRIME_2;
+    acc = XXROTATE(acc);
+    acc *= XXPRIME_1;
+    return acc;
+}
+
+static inline int64_t tuplehash_fin(uint64_t acc, uint64_t len) {
+    acc += len ^ (XXPRIME_5 ^ 3527539);
+    if (acc == (uint64_t)-1) return 1546275796;
+    return (int64_t)acc;
+}
+
+static PyObject *tuple_hash_check(PyObject *self, PyObject *args) {
+    /* recompute hash(t) for a tuple via the local combine — the wrapper
+     * compares with Python's hash() to validate the platform's scheme */
+    PyObject *t;
+    if (!PyArg_ParseTuple(args, "O!", &PyTuple_Type, &t)) return NULL;
+    Py_ssize_t len = PyTuple_GET_SIZE(t);
+    uint64_t acc = XXPRIME_5;
+    for (Py_ssize_t i = 0; i < len; i++) {
+        Py_hash_t lane = PyObject_Hash(PyTuple_GET_ITEM(t, i));
+        if (lane == -1 && PyErr_Occurred()) return NULL;
+        acc = tuplehash_lane(acc, (uint64_t)lane);
+    }
+    return PyLong_FromLongLong(tuplehash_fin(acc, (uint64_t)len));
+}
+
+/* interned attribute names, created at module init */
+static PyObject *s_namespace, *s_object, *s_relation, *s_subject, *s_id;
+
+/* ---------------------------------------------------------------------------
+ * request_hashes(reqs, subject_id_type, hs_addr, ht_addr, isid_addr) -> None
+ *
+ * For each RelationTuple r: hs[i] = hash((r.namespace, r.object,
+ * r.relation)); subject s = r.subject; ht[i] = hash((s.id,)) and isid[i]=1
+ * when type(s) is subject_id_type, else hash((s.namespace, s.object,
+ * s.relation)). One C loop replacing the two per-request key-tuple list
+ * comprehensions + np.fromiter in the encode stage — the object path's
+ * dominant Python-side cost at large batch sizes.
+ * ------------------------------------------------------------------------ */
+static PyObject *request_hashes(PyObject *self, PyObject *args) {
+    PyObject *seq, *idtype;
+    unsigned long long hs_addr, ht_addr, isid_addr;
+    if (!PyArg_ParseTuple(args, "OOKKK", &seq, &idtype, &hs_addr, &ht_addr,
+                          &isid_addr))
+        return NULL;
+    int64_t *hs = (int64_t *)(uintptr_t)hs_addr;
+    int64_t *ht = (int64_t *)(uintptr_t)ht_addr;
+    uint8_t *isid = (uint8_t *)(uintptr_t)isid_addr;
+    PyObject *fast = PySequence_Fast(seq, "request_hashes expects a sequence");
+    if (fast == NULL) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    PyObject **items = PySequence_Fast_ITEMS(fast);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *r = items[i];
+        PyObject *ns = PyObject_GetAttr(r, s_namespace);
+        PyObject *ob = ns ? PyObject_GetAttr(r, s_object) : NULL;
+        PyObject *rel = ob ? PyObject_GetAttr(r, s_relation) : NULL;
+        PyObject *subj = rel ? PyObject_GetAttr(r, s_subject) : NULL;
+        if (subj == NULL) {
+            Py_XDECREF(ns);
+            Py_XDECREF(ob);
+            Py_XDECREF(rel);
+            Py_DECREF(fast);
+            return NULL;
+        }
+        /* stop at the FIRST failed hash: calling PyObject_Hash again with
+         * the exception pending would raise SystemError over the real
+         * error (hash(-1) without an exception is a legal value) */
+        uint64_t acc = XXPRIME_5;
+        Py_hash_t h1 = PyObject_Hash(ns);
+        Py_hash_t h2 = (h1 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(ob);
+        Py_hash_t h3 = (h2 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(rel);
+        Py_DECREF(ns);
+        Py_DECREF(ob);
+        Py_DECREF(rel);
+        if ((h1 == -1 || h2 == -1 || h3 == -1) && PyErr_Occurred()) {
+            Py_DECREF(subj);
+            Py_DECREF(fast);
+            return NULL;
+        }
+        acc = tuplehash_lane(acc, (uint64_t)h1);
+        acc = tuplehash_lane(acc, (uint64_t)h2);
+        acc = tuplehash_lane(acc, (uint64_t)h3);
+        hs[i] = tuplehash_fin(acc, 3);
+
+        if ((PyObject *)Py_TYPE(subj) == idtype) {
+            PyObject *sid = PyObject_GetAttr(subj, s_id);
+            if (sid == NULL) {
+                Py_DECREF(subj);
+                Py_DECREF(fast);
+                return NULL;
+            }
+            Py_hash_t hv = PyObject_Hash(sid);
+            Py_DECREF(sid);
+            if (hv == -1 && PyErr_Occurred()) {
+                Py_DECREF(subj);
+                Py_DECREF(fast);
+                return NULL;
+            }
+            acc = XXPRIME_5;
+            acc = tuplehash_lane(acc, (uint64_t)hv);
+            ht[i] = tuplehash_fin(acc, 1);
+            isid[i] = 1;
+        } else {
+            PyObject *sn = PyObject_GetAttr(subj, s_namespace);
+            PyObject *so = sn ? PyObject_GetAttr(subj, s_object) : NULL;
+            PyObject *sr = so ? PyObject_GetAttr(subj, s_relation) : NULL;
+            if (sr == NULL) {
+                Py_XDECREF(sn);
+                Py_XDECREF(so);
+                Py_DECREF(subj);
+                Py_DECREF(fast);
+                return NULL;
+            }
+            Py_hash_t g1 = PyObject_Hash(sn);
+            Py_hash_t g2 =
+                (g1 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(so);
+            Py_hash_t g3 =
+                (g2 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(sr);
+            Py_DECREF(sn);
+            Py_DECREF(so);
+            Py_DECREF(sr);
+            if ((g1 == -1 || g2 == -1 || g3 == -1) && PyErr_Occurred()) {
+                Py_DECREF(subj);
+                Py_DECREF(fast);
+                return NULL;
+            }
+            acc = XXPRIME_5;
+            acc = tuplehash_lane(acc, (uint64_t)g1);
+            acc = tuplehash_lane(acc, (uint64_t)g2);
+            acc = tuplehash_lane(acc, (uint64_t)g3);
+            ht[i] = tuplehash_fin(acc, 3);
+            isid[i] = 0;
+        }
+        Py_DECREF(subj);
+    }
+    Py_DECREF(fast);
+    Py_RETURN_NONE;
+}
+
+/* ---------------------------------------------------------------------------
  * probe_index(slots_addr, ids_addr, mask, h_addr, n, out_addr) -> None
  *
  * Open-addressing probe of the vocab hash index (vocab.lookup_bulk's table):
@@ -349,6 +504,11 @@ static PyObject *gather_min_u8(PyObject *self, PyObject *args) {
 static PyMethodDef Methods[] = {
     {"object_hashes", object_hashes, METH_VARARGS,
      "hash each element of a sequence into an int64 buffer"},
+    {"tuple_hash_check", tuple_hash_check, METH_VARARGS,
+     "recompute a tuple's hash with the local combine (parity probe)"},
+    {"request_hashes", request_hashes, METH_VARARGS,
+     "subject-set/target key hashes + is_id flags straight off "
+     "RelationTuple objects"},
     {"probe_index", probe_index, METH_VARARGS,
      "prefetched open-addressing probe of the vocab hash index"},
     {"closure_check", closure_check, METH_VARARGS,
@@ -362,4 +522,13 @@ static struct PyModuleDef moduledef = {
     "native hot-path kernels (prefetch-pipelined gathers)", -1, Methods,
     NULL, NULL, NULL, NULL};
 
-PyMODINIT_FUNC PyInit__hotpath(void) { return PyModule_Create(&moduledef); }
+PyMODINIT_FUNC PyInit__hotpath(void) {
+    s_namespace = PyUnicode_InternFromString("namespace");
+    s_object = PyUnicode_InternFromString("object");
+    s_relation = PyUnicode_InternFromString("relation");
+    s_subject = PyUnicode_InternFromString("subject");
+    s_id = PyUnicode_InternFromString("id");
+    if (!s_namespace || !s_object || !s_relation || !s_subject || !s_id)
+        return NULL;
+    return PyModule_Create(&moduledef);
+}

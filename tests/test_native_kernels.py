@@ -78,6 +78,68 @@ class TestProbeParity:
         assert got_native.tolist() == exact
 
 
+class TestRequestHashes:
+    def test_tuple_hash_parity(self):
+        assert native.tuple_hash_ok
+        for tup in [("a", "b", "c"), ("x",), ("", "", ""), ("u" * 99,)]:
+            assert native.lib.tuple_hash_check(tup) == hash(tup)
+
+    def test_hashes_and_flags(self):
+        from keto_tpu.relationtuple import SubjectID, SubjectSet
+
+        reqs = [
+            t("n:o1#r@alice"),
+            t("n:o2#r@(m:g#member)"),
+            t(":#@()"),  # empty-string fields are legal key material
+        ]
+        hs, ht, is_id = native.request_hashes(reqs, SubjectID)
+        for i, r in enumerate(reqs):
+            assert hs[i] == hash((r.namespace, r.object, r.relation))
+            s = r.subject
+            want = (
+                hash((s.id,))
+                if isinstance(s, SubjectID)
+                else hash((s.namespace, s.object, s.relation))
+            )
+            assert ht[i] == want
+            assert is_id[i] == isinstance(s, SubjectID)
+
+    def test_lookup_hashes_matches_lookup_bulk(self):
+        from keto_tpu.graph.vocab import NodeVocab
+
+        vocab = NodeVocab()
+        keys = [("n", f"o{i}", "r") for i in range(500)] + [
+            (f"u{i}",) for i in range(500)
+        ]
+        vocab.intern_bulk(keys)
+        probe = keys[::3] + [("n", "nope", "r"), ("ghost",)]
+        h = np.fromiter((hash(k) for k in probe), np.int64, count=len(probe))
+        got = vocab.lookup_hashes(h, lambda i: probe[i])
+        want = vocab.lookup_bulk(probe)
+        np.testing.assert_array_equal(got, want)
+
+    def test_lookup_hashes_collision_fallback(self):
+        """Keys routed to the exact dict when their hash collides inside
+        the vocab must still resolve through key_fn."""
+        from keto_tpu.graph.vocab import NodeVocab
+
+        vocab = NodeVocab()
+        keys = [("n", f"o{i}", "r") for i in range(64)]
+        vocab.intern_bulk(keys)
+        vocab._extend_hash_index()
+        # force a recorded collision for one stored hash
+        mask, slots, slot_ids, collisions, upto = vocab._h_table
+        victim = keys[7]
+        collisions.add(hash(victim))
+        vocab._h_table = (mask, slots, slot_ids, collisions, upto)
+        h = np.array([hash(victim)], np.int64)
+        got = vocab.lookup_hashes(h, lambda i: victim)
+        assert got[0] == vocab.lookup(victim)
+        # a DIFFERENT key with that same hash value resolves to unknown
+        got_missing = vocab.lookup_hashes(h, lambda i: ("not", "a", "key"))
+        assert got_missing[0] == -1
+
+
 class TestClosureCheckParity:
     @pytest.mark.parametrize("seed", range(4))
     def test_native_vs_numpy_vs_oracle(self, seed, monkeypatch):
