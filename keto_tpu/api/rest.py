@@ -254,6 +254,9 @@ class ReadAPI:
 
     async def get_relations(self, request: web.Request) -> web.Response:
         p = request.rel_url.query
+        # snaptoken (keto_tpu REST extension, mirroring the gRPC field):
+        # validated, then trivially satisfied — list reads the live store
+        _min_version_from_query(p)
         query = RelationQuery(
             namespace=p.get("namespace"),
             object=p.get("object"),
@@ -338,6 +341,10 @@ class ReadAPI:
 
     async def get_expand(self, request: web.Request) -> web.Response:
         p = request.rel_url.query
+        # snaptoken: validated; expand serves at the live store version by
+        # construction (SnapshotManager re-encodes on read), so any token
+        # this server issued is already satisfied
+        _min_version_from_query(p)
         for key in ("namespace", "object", "relation"):
             if p.get(key) is None:
                 raise ErrMalformedInput(f"missing query parameter {key}")

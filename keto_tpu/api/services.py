@@ -149,6 +149,13 @@ class ExpandServicer:
             )
             if subject is None:
                 raise ErrMalformedInput("expand request without subject")
+            # ExpandRequest.snaptoken (at-least-as-fresh): validated, then
+            # trivially satisfied — the expand engine reads through the
+            # SnapshotManager, which re-encodes to the LIVE store version
+            # on every read, so the serving version is always >= any token
+            # this server issued. (The reference ignores the field,
+            # expand_service.proto:15.)
+            min_version_from(request.snaptoken, False)
             tree = self.expand_engine.build_tree(subject, request.max_depth)
             proto_tree = tree_to_proto(tree)
             if proto_tree is None:
@@ -162,6 +169,9 @@ class ReadServicer:
     def __init__(self, manager):
         self.manager = manager
 
+    # RelationTuple fields a ListRelationTuplesRequest.expand_mask may name
+    _MASKABLE = frozenset({"namespace", "object", "relation", "subject"})
+
     def ListRelationTuples(self, request, context):
         try:
             q = request.query
@@ -171,14 +181,37 @@ class ReadServicer:
                 q.relation,
                 q.subject if q.HasField("subject") else None,
             )
+            # snaptoken (at-least-as-fresh): validated, then trivially
+            # satisfied — the list reads the LIVE store, which is by
+            # definition at the newest version. (The reference ignores the
+            # field, read_service.proto:23.)
+            min_version_from(request.snaptoken, False)
+            mask = None
+            # an empty path list means "no projection" (FieldMask read
+            # convention), not "clear everything"
+            if request.HasField("expand_mask") and request.expand_mask.paths:
+                mask = set(request.expand_mask.paths)
+                unknown = mask - self._MASKABLE
+                if unknown:
+                    raise ErrMalformedInput(
+                        "expand_mask names unknown RelationTuple fields: "
+                        + ", ".join(sorted(unknown))
+                    )
             tuples, next_token = self.manager.get_relation_tuples(
                 query,
                 PaginationOptions(
                     token=request.page_token, size=request.page_size
                 ),
             )
+            protos = [tuple_to_proto(t) for t in tuples]
+            if mask is not None:
+                # FieldMask projection (implemented here; the reference
+                # ignores the field): clear every unnamed field
+                for pt in protos:
+                    for f in self._MASKABLE - mask:
+                        pt.ClearField(f)
             return read_service_pb2.ListRelationTuplesResponse(
-                relation_tuples=[tuple_to_proto(t) for t in tuples],
+                relation_tuples=protos,
                 next_page_token=next_token,
             )
         except Exception as e:

@@ -139,11 +139,23 @@ class Registry:
                     dsn[len("sqlite://"):],
                     namespace_manager=self.namespace_manager(),
                 )
+            elif dsn.startswith(("postgres://", "postgresql://")):
+                from ..persistence.postgres import PostgresTupleStore
+
+                # the dialect raises a clear RuntimeError when no psycopg
+                # driver exists in the image; surface it as a config error
+                try:
+                    self._store = PostgresTupleStore(
+                        dsn, namespace_manager=self.namespace_manager()
+                    )
+                except RuntimeError as e:
+                    raise ErrMalformedInput(str(e)) from e
             else:
                 raise ErrMalformedInput(
                     f"unsupported DSN {dsn!r}: this build supports 'memory', "
-                    "'columnar', and 'sqlite://<path>' (postgres/mysql/"
-                    "cockroach drivers are not present in the runtime image)"
+                    "'columnar', 'sqlite://<path>', and 'postgres://...' "
+                    "(the postgres adapter needs a psycopg driver; mysql/"
+                    "cockroach would be further SQLDialect bindings)"
                 )
         return self._store
 

@@ -104,6 +104,74 @@ static PyObject *tuple_hash_check(PyObject *self, PyObject *args) {
 static PyObject *s_namespace, *s_object, *s_relation, *s_subject, *s_id;
 
 /* ---------------------------------------------------------------------------
+ * Direct slot access for the frozen/slotted domain dataclasses.
+ *
+ * RelationTuple/SubjectID/SubjectSet are __slots__ classes, so their
+ * attributes are member descriptors with fixed byte offsets. Reading
+ * *(PyObject **)((char *)obj + offset) skips the descriptor protocol —
+ * ~10x cheaper than PyObject_GetAttr per field, and the encode loop does
+ * 4-7 reads per request. Offsets are discovered once per type via the
+ * public PyMemberDescr API and verified to be plain T_OBJECT_EX members;
+ * anything unexpected (subclass, non-slot attribute) falls back to
+ * GetAttr per item — never wrong, only slower.
+ * ------------------------------------------------------------------------ */
+#include <structmember.h>
+
+typedef struct {
+    PyTypeObject *type; /* borrowed; NULL = not initialized */
+    Py_ssize_t off_ns, off_obj, off_rel, off_subj; /* RelationTuple */
+    Py_ssize_t off_id;                             /* SubjectID */
+    Py_ssize_t off_sns, off_sobj, off_srel;        /* SubjectSet */
+} SlotCache;
+
+static SlotCache rt_cache, sid_cache, sset_cache;
+/* types whose discovery failed: skip re-probing them every item */
+static PyTypeObject *rt_failed, *sid_failed, *sset_failed;
+
+static void cache_type(PyTypeObject **slot, PyTypeObject *tp) {
+    /* hold a strong reference: a cached address must never be re-matched
+     * after the type dies and another class lands at the same address
+     * (stale offsets would read garbage). The handful of pinned domain
+     * classes live for the process anyway. */
+    Py_XINCREF((PyObject *)tp);
+    Py_XDECREF((PyObject *)*slot);
+    *slot = tp;
+}
+
+static Py_ssize_t member_offset(PyTypeObject *tp, PyObject *name) {
+    /* getattr on the TYPE yields the descriptor object itself */
+    PyObject *descr = PyObject_GetAttr((PyObject *)tp, name);
+    if (descr == NULL) {
+        PyErr_Clear();
+        return -1;
+    }
+    Py_ssize_t off = -1;
+    if (Py_TYPE(descr) == &PyMemberDescr_Type) {
+        PyMemberDef *m = ((PyMemberDescrObject *)descr)->d_member;
+        if (m != NULL && m->type == T_OBJECT_EX) off = m->offset;
+    }
+    Py_DECREF(descr);
+    return off;
+}
+
+static inline PyObject *slot_read(PyObject *obj, Py_ssize_t off) {
+    /* borrowed reference; frozen dataclasses always have slots filled,
+     * but guard NULL anyway (caller falls back to GetAttr) */
+    return *(PyObject **)((char *)obj + off);
+}
+
+static inline uint64_t str_hash(PyObject *o, int *err) {
+    /* unicode objects cache their hash; read it without the tp_hash call */
+    if (PyUnicode_CheckExact(o)) {
+        Py_hash_t h = ((PyASCIIObject *)o)->hash;
+        if (h != -1) return (uint64_t)h;
+    }
+    Py_hash_t h = PyObject_Hash(o);
+    if (h == -1 && PyErr_Occurred()) *err = 1;
+    return (uint64_t)h;
+}
+
+/* ---------------------------------------------------------------------------
  * request_hashes(reqs, subject_id_type, hs_addr, ht_addr, isid_addr) -> None
  *
  * For each RelationTuple r: hs[i] = hash((r.namespace, r.object,
@@ -113,6 +181,85 @@ static PyObject *s_namespace, *s_object, *s_relation, *s_subject, *s_id;
  * comprehensions + np.fromiter in the encode stage — the object path's
  * dominant Python-side cost at large batch sizes.
  * ------------------------------------------------------------------------ */
+static int hash_item_slow(PyObject *r, PyObject *idtype, int64_t *hs,
+                          int64_t *ht, uint8_t *isid) {
+    /* GetAttr path: any object shape. Returns 0 ok, -1 with exception. */
+    PyObject *ns = PyObject_GetAttr(r, s_namespace);
+    PyObject *ob = ns ? PyObject_GetAttr(r, s_object) : NULL;
+    PyObject *rel = ob ? PyObject_GetAttr(r, s_relation) : NULL;
+    PyObject *subj = rel ? PyObject_GetAttr(r, s_subject) : NULL;
+    if (subj == NULL) {
+        Py_XDECREF(ns);
+        Py_XDECREF(ob);
+        Py_XDECREF(rel);
+        return -1;
+    }
+    /* stop at the FIRST failed hash: calling PyObject_Hash again with
+     * the exception pending would raise SystemError over the real
+     * error (hash(-1) without an exception is a legal value) */
+    uint64_t acc = XXPRIME_5;
+    Py_hash_t h1 = PyObject_Hash(ns);
+    Py_hash_t h2 = (h1 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(ob);
+    Py_hash_t h3 = (h2 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(rel);
+    Py_DECREF(ns);
+    Py_DECREF(ob);
+    Py_DECREF(rel);
+    if ((h1 == -1 || h2 == -1 || h3 == -1) && PyErr_Occurred()) {
+        Py_DECREF(subj);
+        return -1;
+    }
+    acc = tuplehash_lane(acc, (uint64_t)h1);
+    acc = tuplehash_lane(acc, (uint64_t)h2);
+    acc = tuplehash_lane(acc, (uint64_t)h3);
+    *hs = tuplehash_fin(acc, 3);
+
+    if ((PyObject *)Py_TYPE(subj) == idtype) {
+        PyObject *sid = PyObject_GetAttr(subj, s_id);
+        if (sid == NULL) {
+            Py_DECREF(subj);
+            return -1;
+        }
+        Py_hash_t hv = PyObject_Hash(sid);
+        Py_DECREF(sid);
+        if (hv == -1 && PyErr_Occurred()) {
+            Py_DECREF(subj);
+            return -1;
+        }
+        acc = XXPRIME_5;
+        acc = tuplehash_lane(acc, (uint64_t)hv);
+        *ht = tuplehash_fin(acc, 1);
+        *isid = 1;
+    } else {
+        PyObject *sn = PyObject_GetAttr(subj, s_namespace);
+        PyObject *so = sn ? PyObject_GetAttr(subj, s_object) : NULL;
+        PyObject *sr = so ? PyObject_GetAttr(subj, s_relation) : NULL;
+        if (sr == NULL) {
+            Py_XDECREF(sn);
+            Py_XDECREF(so);
+            Py_DECREF(subj);
+            return -1;
+        }
+        Py_hash_t g1 = PyObject_Hash(sn);
+        Py_hash_t g2 = (g1 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(so);
+        Py_hash_t g3 = (g2 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(sr);
+        Py_DECREF(sn);
+        Py_DECREF(so);
+        Py_DECREF(sr);
+        if ((g1 == -1 || g2 == -1 || g3 == -1) && PyErr_Occurred()) {
+            Py_DECREF(subj);
+            return -1;
+        }
+        acc = XXPRIME_5;
+        acc = tuplehash_lane(acc, (uint64_t)g1);
+        acc = tuplehash_lane(acc, (uint64_t)g2);
+        acc = tuplehash_lane(acc, (uint64_t)g3);
+        *ht = tuplehash_fin(acc, 3);
+        *isid = 0;
+    }
+    Py_DECREF(subj);
+    return 0;
+}
+
 static PyObject *request_hashes(PyObject *self, PyObject *args) {
     PyObject *seq, *idtype;
     unsigned long long hs_addr, ht_addr, isid_addr;
@@ -128,87 +275,94 @@ static PyObject *request_hashes(PyObject *self, PyObject *args) {
     PyObject **items = PySequence_Fast_ITEMS(fast);
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *r = items[i];
-        PyObject *ns = PyObject_GetAttr(r, s_namespace);
-        PyObject *ob = ns ? PyObject_GetAttr(r, s_object) : NULL;
-        PyObject *rel = ob ? PyObject_GetAttr(r, s_relation) : NULL;
-        PyObject *subj = rel ? PyObject_GetAttr(r, s_subject) : NULL;
-        if (subj == NULL) {
-            Py_XDECREF(ns);
-            Py_XDECREF(ob);
-            Py_XDECREF(rel);
-            Py_DECREF(fast);
-            return NULL;
+        PyTypeObject *tp = Py_TYPE(r);
+        if (rt_cache.type == NULL && tp != rt_failed) {
+            /* discover RelationTuple's slot layout from the first item */
+            SlotCache c;
+            c.off_ns = member_offset(tp, s_namespace);
+            c.off_obj = member_offset(tp, s_object);
+            c.off_rel = member_offset(tp, s_relation);
+            c.off_subj = member_offset(tp, s_subject);
+            if (c.off_ns >= 0 && c.off_obj >= 0 && c.off_rel >= 0 &&
+                c.off_subj >= 0) {
+                rt_cache = c;
+                cache_type(&rt_cache.type, tp);
+            } else {
+                cache_type(&rt_failed, tp);
+            }
         }
-        /* stop at the FIRST failed hash: calling PyObject_Hash again with
-         * the exception pending would raise SystemError over the real
-         * error (hash(-1) without an exception is a legal value) */
-        uint64_t acc = XXPRIME_5;
-        Py_hash_t h1 = PyObject_Hash(ns);
-        Py_hash_t h2 = (h1 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(ob);
-        Py_hash_t h3 = (h2 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(rel);
-        Py_DECREF(ns);
-        Py_DECREF(ob);
-        Py_DECREF(rel);
-        if ((h1 == -1 || h2 == -1 || h3 == -1) && PyErr_Occurred()) {
-            Py_DECREF(subj);
-            Py_DECREF(fast);
-            return NULL;
-        }
-        acc = tuplehash_lane(acc, (uint64_t)h1);
-        acc = tuplehash_lane(acc, (uint64_t)h2);
-        acc = tuplehash_lane(acc, (uint64_t)h3);
-        hs[i] = tuplehash_fin(acc, 3);
+        if (tp != rt_cache.type) goto slow;
+        {
+            PyObject *ns = slot_read(r, rt_cache.off_ns);
+            PyObject *ob = slot_read(r, rt_cache.off_obj);
+            PyObject *rel = slot_read(r, rt_cache.off_rel);
+            PyObject *subj = slot_read(r, rt_cache.off_subj);
+            if (!ns || !ob || !rel || !subj) goto slow;
+            int err = 0;
+            uint64_t acc = XXPRIME_5;
+            acc = tuplehash_lane(acc, str_hash(ns, &err));
+            if (err) goto fail;
+            acc = tuplehash_lane(acc, str_hash(ob, &err));
+            if (err) goto fail;
+            acc = tuplehash_lane(acc, str_hash(rel, &err));
+            if (err) goto fail;
+            hs[i] = tuplehash_fin(acc, 3);
 
-        if ((PyObject *)Py_TYPE(subj) == idtype) {
-            PyObject *sid = PyObject_GetAttr(subj, s_id);
-            if (sid == NULL) {
-                Py_DECREF(subj);
-                Py_DECREF(fast);
-                return NULL;
+            PyTypeObject *stp = Py_TYPE(subj);
+            if ((PyObject *)stp == idtype) {
+                if (sid_cache.type != stp) {
+                    if (stp == sid_failed) goto slow;
+                    Py_ssize_t off = member_offset(stp, s_id);
+                    if (off < 0) {
+                        cache_type(&sid_failed, stp);
+                        goto slow;
+                    }
+                    sid_cache.off_id = off;
+                    cache_type(&sid_cache.type, stp);
+                }
+                PyObject *sid = slot_read(subj, sid_cache.off_id);
+                if (!sid) goto slow;
+                acc = XXPRIME_5;
+                acc = tuplehash_lane(acc, str_hash(sid, &err));
+                if (err) goto fail;
+                ht[i] = tuplehash_fin(acc, 1);
+                isid[i] = 1;
+            } else {
+                if (sset_cache.type != stp) {
+                    if (stp == sset_failed) goto slow;
+                    SlotCache c;
+                    c.off_sns = member_offset(stp, s_namespace);
+                    c.off_sobj = member_offset(stp, s_object);
+                    c.off_srel = member_offset(stp, s_relation);
+                    if (c.off_sns < 0 || c.off_sobj < 0 || c.off_srel < 0) {
+                        cache_type(&sset_failed, stp);
+                        goto slow;
+                    }
+                    sset_cache = c;
+                    cache_type(&sset_cache.type, stp);
+                }
+                PyObject *sn = slot_read(subj, sset_cache.off_sns);
+                PyObject *so = slot_read(subj, sset_cache.off_sobj);
+                PyObject *sr = slot_read(subj, sset_cache.off_srel);
+                if (!sn || !so || !sr) goto slow;
+                acc = XXPRIME_5;
+                acc = tuplehash_lane(acc, str_hash(sn, &err));
+                if (err) goto fail;
+                acc = tuplehash_lane(acc, str_hash(so, &err));
+                if (err) goto fail;
+                acc = tuplehash_lane(acc, str_hash(sr, &err));
+                if (err) goto fail;
+                ht[i] = tuplehash_fin(acc, 3);
+                isid[i] = 0;
             }
-            Py_hash_t hv = PyObject_Hash(sid);
-            Py_DECREF(sid);
-            if (hv == -1 && PyErr_Occurred()) {
-                Py_DECREF(subj);
-                Py_DECREF(fast);
-                return NULL;
-            }
-            acc = XXPRIME_5;
-            acc = tuplehash_lane(acc, (uint64_t)hv);
-            ht[i] = tuplehash_fin(acc, 1);
-            isid[i] = 1;
-        } else {
-            PyObject *sn = PyObject_GetAttr(subj, s_namespace);
-            PyObject *so = sn ? PyObject_GetAttr(subj, s_object) : NULL;
-            PyObject *sr = so ? PyObject_GetAttr(subj, s_relation) : NULL;
-            if (sr == NULL) {
-                Py_XDECREF(sn);
-                Py_XDECREF(so);
-                Py_DECREF(subj);
-                Py_DECREF(fast);
-                return NULL;
-            }
-            Py_hash_t g1 = PyObject_Hash(sn);
-            Py_hash_t g2 =
-                (g1 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(so);
-            Py_hash_t g3 =
-                (g2 == -1 && PyErr_Occurred()) ? -1 : PyObject_Hash(sr);
-            Py_DECREF(sn);
-            Py_DECREF(so);
-            Py_DECREF(sr);
-            if ((g1 == -1 || g2 == -1 || g3 == -1) && PyErr_Occurred()) {
-                Py_DECREF(subj);
-                Py_DECREF(fast);
-                return NULL;
-            }
-            acc = XXPRIME_5;
-            acc = tuplehash_lane(acc, (uint64_t)g1);
-            acc = tuplehash_lane(acc, (uint64_t)g2);
-            acc = tuplehash_lane(acc, (uint64_t)g3);
-            ht[i] = tuplehash_fin(acc, 3);
-            isid[i] = 0;
+            continue;
         }
-        Py_DECREF(subj);
+    slow:
+        if (hash_item_slow(r, idtype, &hs[i], &ht[i], &isid[i]) < 0) goto fail;
+        continue;
+    fail:
+        Py_DECREF(fast);
+        return NULL;
     }
     Py_DECREF(fast);
     Py_RETURN_NONE;

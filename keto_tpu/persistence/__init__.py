@@ -11,7 +11,25 @@ The device snapshot layer is persistence-agnostic: any store exposing the
 Manager contract plus the version/delta feed can sit under it.
 """
 
+from .dialect import (
+    DIALECTS,
+    PostgresDialect,
+    SQLDialect,
+    SQLiteDialect,
+    dialect_for_dsn,
+)
 from .migrator import Migrator, MigrationStatus
 from .sqlite import SQLiteTupleStore
+from .sqlstore import SQLTupleStore
 
-__all__ = ["Migrator", "MigrationStatus", "SQLiteTupleStore"]
+__all__ = [
+    "DIALECTS",
+    "Migrator",
+    "MigrationStatus",
+    "PostgresDialect",
+    "SQLDialect",
+    "SQLTupleStore",
+    "SQLiteDialect",
+    "SQLiteTupleStore",
+    "dialect_for_dsn",
+]

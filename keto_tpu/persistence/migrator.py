@@ -35,6 +35,24 @@ def _is_txn_control(stmt: str) -> bool:
     return _TXN_CONTROL_RE.fullmatch(bare) is not None
 
 
+def _generic_in_transaction(conn) -> bool:
+    """Best-effort open-transaction probe for non-sqlite DB-API drivers:
+    psycopg3 (conn.info.transaction_status), psycopg2
+    (conn.get_transaction_status()) — 0 is IDLE for both. Unknown drivers
+    report False (no guard possible)."""
+    info = getattr(conn, "info", None)
+    status = getattr(info, "transaction_status", None)
+    if status is not None:
+        return int(status) != 0
+    get_status = getattr(conn, "get_transaction_status", None)
+    if callable(get_status):
+        try:
+            return int(get_status()) != 0
+        except Exception:
+            return False
+    return False
+
+
 @dataclass(frozen=True)
 class Migration:
     version: str
@@ -50,16 +68,31 @@ class MigrationStatus:
     applied: bool
 
 
-def load_migrations(directory: str) -> list[Migration]:
+def load_migrations(directory: str, dialect=None) -> list[Migration]:
+    """Migrations for one dialect: generic files, with per-dialect overlays
+    (<ver>_<name>.<dialect>.{up,down}.sql) replacing the generic file of the
+    same version/direction — the reference's per-dialect migration scheme
+    (internal/persistence/sql/migrations/sql/*.postgres.up.sql etc.)."""
+    if dialect is not None:
+        files = dialect.migration_files(directory)
+    else:
+        # no dialect: generic files only — an overlay file's extra dot
+        # (<ver>_<name>.<dialect>.up.sql) must not leak into the ladder,
+        # where sort order would decide which engine's SQL wins
+        files = {
+            f: os.path.join(directory, f)
+            for f in sorted(os.listdir(directory))
+            if f.endswith(".sql") and f.count(".") == 2
+        }
     found: dict[str, dict] = {}
-    for fname in sorted(os.listdir(directory)):
+    for fname, path in sorted(files.items()):
         m = _FILE_RE.match(fname)
         if not m:
             continue
         entry = found.setdefault(
             m.group("version"), {"name": m.group("name"), "up": "", "down": ""}
         )
-        with open(os.path.join(directory, fname)) as f:
+        with open(path) as f:
             entry[m.group("dir")] = f.read()
     return [
         Migration(
@@ -75,18 +108,29 @@ def load_migrations(directory: str) -> list[Migration]:
 class Migrator:
     TABLE = "keto_migrations"
 
-    def __init__(self, conn: sqlite3.Connection, directory: str):
+    def __init__(self, conn, directory: str, dialect=None):
         self.conn = conn
-        self.migrations = load_migrations(directory)
-        conn.execute(
+        self.dialect = dialect
+        self.migrations = load_migrations(directory, dialect=dialect)
+        self._exec(
             f"CREATE TABLE IF NOT EXISTS {self.TABLE} ("
             "version TEXT PRIMARY KEY, name TEXT NOT NULL, "
             "applied_at REAL NOT NULL)"
         )
         conn.commit()
 
+    def _exec(self, sql: str, params: tuple = ()):
+        """Cursor-based execute: sqlite3 allows conn.execute, generic
+        DB-API drivers (psycopg2) do not. Placeholders stay qmark for
+        sqlite, rewritten by the dialect otherwise."""
+        if self.dialect is not None:
+            sql = self.dialect.sql(sql)
+        cur = self.conn.cursor()
+        cur.execute(sql, params)
+        return cur
+
     def applied_versions(self) -> set[str]:
-        rows = self.conn.execute(f"SELECT version FROM {self.TABLE}").fetchall()
+        rows = self._exec(f"SELECT version FROM {self.TABLE}").fetchall()
         return {r[0] for r in rows}
 
     def status(self) -> list[MigrationStatus]:
@@ -105,6 +149,29 @@ class Migrator:
         unusable here: it issues an implicit COMMIT before running, so a
         failing multi-statement migration would leave partial DDL applied
         with no version row recorded."""
+        if not isinstance(self.conn, sqlite3.Connection):
+            # generic DB-API path (postgres, ...): the driver opens the
+            # transaction implicitly; commit/rollback close it. Transactional
+            # DDL is a postgres strength, so the one-txn-per-migration
+            # contract holds there too.
+            if _generic_in_transaction(self.conn):
+                # same guard as the sqlite branch: our commit()/rollback()
+                # below must not absorb the caller's uncommitted work
+                raise RuntimeError(
+                    "cannot run migrations: connection has an open "
+                    "transaction"
+                )
+            try:
+                for stmt in _split_statements(script):
+                    if _is_txn_control(stmt):
+                        continue
+                    self._exec(stmt)
+                self._exec(record_sql, tuple(params))
+                self.conn.commit()
+            except BaseException:
+                self.conn.rollback()
+                raise
+            return
         if self.conn.in_transaction:
             # assigning isolation_level below would silently COMMIT the
             # caller's pending writes; refuse instead of surprising them

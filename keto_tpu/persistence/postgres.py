@@ -1,0 +1,36 @@
+"""Postgres tuple store: the dialect-neutral SQL store bound to psycopg
+(reference internal/persistence/sql with the postgres DSN,
+dsn_testutils.go:45-52; per-dialect migrations persister.go:50-51).
+
+The runtime image ships no postgres driver, so constructing this store here
+raises a clear RuntimeError from the dialect's lazy driver import; the
+store contract suite marks its postgres leg skipped without a driver or a
+``KETO_TEST_PG_DSN`` (README "persistence"). The SQL itself is exercised
+through the shared `SQLTupleStore` + the postgres migration overlays
+(migrations/sql/*.postgres.*.sql).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..namespace.definitions import NamespaceManager
+from .dialect import PostgresDialect
+from .sqlstore import SQLTupleStore
+
+
+class PostgresTupleStore(SQLTupleStore):
+    def __init__(
+        self,
+        dsn: str,
+        namespace_manager: Optional[NamespaceManager] = None,
+        network_id: Optional[str] = None,
+        auto_migrate: bool = True,
+    ):
+        super().__init__(
+            PostgresDialect(),
+            dsn,
+            namespace_manager=namespace_manager,
+            network_id=network_id,
+            auto_migrate=auto_migrate,
+        )

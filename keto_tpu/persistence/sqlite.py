@@ -1,65 +1,21 @@
-"""SQLite tuple store: the Manager contract on the stdlib driver.
-
-Plays the reference's SQL persister role (internal/persistence/sql/
-persister.go, relationtuples.go): single ``keto_relation_tuples`` table,
-network-id (nid) scoping on every query (QueryWithNetwork,
-persister.go:94-96), subject split across NULL-disjoint columns with partial
-indexes (whereSubject, relationtuples.go:151-176), offset page tokens,
-per-call transactions, uuid shard ids. Rows keep insertion order via the
-autoincrement ``seq`` so pagination is totally ordered (reference ORDER BY,
-relationtuples.go:249-260).
-
-Exposes the same version/delta feed as the in-memory store so the device
-snapshot layer (keto_tpu.graph) sits on either backend unchanged; the write
-counter is durable (``keto_store_version``), making snaptokens survive
-restarts.
+"""SQLite tuple store: the dialect-neutral SQL store bound to the stdlib
+driver (reference internal/persistence/sql with the sqlite DSN,
+dsn_testutils.go:24-34). All persister logic lives in
+`persistence.sqlstore.SQLTupleStore`; this binding only picks the dialect —
+the same shape a postgres/mysql/cockroach binding takes (see
+`persistence.postgres`).
 """
 
 from __future__ import annotations
 
-import os
-import sqlite3
-import threading
-import time
-import uuid
-from typing import Callable, Optional, Sequence
+from typing import Optional
 
 from ..namespace.definitions import NamespaceManager
-from ..relationtuple.definitions import (
-    Manager,
-    RelationQuery,
-    RelationTuple,
-    SubjectID,
-    SubjectSet,
-)
-from ..utils.errors import ErrInvalidTuple
-from ..utils.pagination import (
-    PaginationOptions,
-    decode_page_token,
-    encode_page_token,
-)
-
-_MIGRATIONS_DIR = os.path.join(os.path.dirname(__file__), "migrations", "sql")
+from .dialect import SQLiteDialect
+from .sqlstore import SQLTupleStore
 
 
-def _row_to_tuple(row) -> RelationTuple:
-    (namespace, object_, relation, subject_id, sns, sobj, srel) = row
-    if subject_id is not None:
-        subject = SubjectID(id=subject_id)
-    else:
-        subject = SubjectSet(namespace=sns, object=sobj, relation=srel)
-    return RelationTuple(
-        namespace=namespace, object=object_, relation=relation, subject=subject
-    )
-
-
-def _subject_columns(t: RelationTuple):
-    if isinstance(t.subject, SubjectID):
-        return (t.subject.id, None, None, None)
-    return (None, t.subject.namespace, t.subject.object, t.subject.relation)
-
-
-class SQLiteTupleStore(Manager):
+class SQLiteTupleStore(SQLTupleStore):
     def __init__(
         self,
         path: str,
@@ -68,238 +24,10 @@ class SQLiteTupleStore(Manager):
         auto_migrate: bool = True,
     ):
         self.path = path or ":memory:"
-        self.namespace_manager = namespace_manager
-        self._lock = threading.RLock()
-        self._conn = sqlite3.connect(self.path, check_same_thread=False)
-        self._conn.execute("PRAGMA journal_mode=WAL")
-        self._conn.execute("PRAGMA foreign_keys=ON")
-        from .migrator import Migrator
-
-        self.migrator = Migrator(self._conn, _MIGRATIONS_DIR)
-        if auto_migrate:
-            self.migrator.up()
-        if network_id is not None:
-            self.network_id = network_id
-        else:
-            self.network_id = self._determine_network()
-        self._listeners: list[Callable[[int], None]] = []
-        self._delta_listeners: list[Callable] = []
-
-    def _determine_network(self) -> str:
-        """Adopt the database's oldest network, creating one on a fresh
-        database — a restarted server keeps seeing its own rows (reference
-        determineNetwork, registry_default.go:207-225)."""
-        try:
-            row = self._conn.execute(
-                "SELECT id FROM keto_networks ORDER BY created_at LIMIT 1"
-            ).fetchone()
-        except sqlite3.OperationalError:
-            # migrations not applied yet (auto_migrate=False): ephemeral id;
-            # re-determined once the operator migrates and reopens
-            return str(uuid.uuid4())
-        if row is not None:
-            return row[0]
-        nid = str(uuid.uuid4())
-        with self._conn:
-            self._conn.execute(
-                "INSERT INTO keto_networks (id, created_at) VALUES (?, ?)",
-                (nid, time.time()),
-            )
-        return nid
-
-    # -- version / change feed (same surface as InMemoryTupleStore) -----------
-
-    @property
-    def version(self) -> int:
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT version FROM keto_store_version WHERE nid = ?",
-                (self.network_id,),
-            ).fetchone()
-            return row[0] if row else 0
-
-    def subscribe(self, fn: Callable[[int], None]) -> None:
-        self._listeners.append(fn)
-
-    def subscribe_deltas(self, fn: Callable) -> None:
-        self._delta_listeners.append(fn)
-
-    def unsubscribe_deltas(self, fn) -> None:
-        try:
-            self._delta_listeners.remove(fn)
-        except ValueError:
-            pass
-
-    def _bump_locked(self) -> int:
-        cur = self._conn.execute(
-            "INSERT INTO keto_store_version (nid, version) VALUES (?, 1) "
-            "ON CONFLICT(nid) DO UPDATE SET version = version + 1 "
-            "RETURNING version",
-            (self.network_id,),
+        super().__init__(
+            SQLiteDialect(),
+            self.path,
+            namespace_manager=namespace_manager,
+            network_id=network_id,
+            auto_migrate=auto_migrate,
         )
-        return cur.fetchone()[0]
-
-    def _notify(self, version, inserted=None, deleted=None) -> None:
-        for fn in self._listeners:
-            fn(version)
-        for fn in self._delta_listeners:
-            fn(version, inserted or [], deleted or [])
-
-    # -- validation ------------------------------------------------------------
-
-    def _validate(self, t: RelationTuple) -> None:
-        if t.subject is None:
-            raise ErrInvalidTuple("subject must not be nil")
-        if self.namespace_manager is not None:
-            self.namespace_manager.get_namespace_by_name(t.namespace)
-
-    # -- query building --------------------------------------------------------
-
-    def _where(self, query: RelationQuery):
-        clauses = ["nid = ?"]
-        params: list = [self.network_id]
-        if query.namespace is not None:
-            clauses.append("namespace = ?")
-            params.append(query.namespace)
-        if query.object is not None:
-            clauses.append("object = ?")
-            params.append(query.object)
-        if query.relation is not None:
-            clauses.append("relation = ?")
-            params.append(query.relation)
-        if query.subject is not None:
-            sid, sns, sobj, srel = _subject_columns(
-                RelationTuple("", "", "", query.subject)
-            )
-            if sid is not None:
-                clauses.append("subject_id = ?")
-                params.append(sid)
-            else:
-                clauses.append(
-                    "subject_set_namespace = ? AND subject_set_object = ? "
-                    "AND subject_set_relation = ?"
-                )
-                params.extend([sns, sobj, srel])
-        return " AND ".join(clauses), params
-
-    # -- Manager contract ------------------------------------------------------
-
-    def get_relation_tuples(
-        self, query: RelationQuery, pagination: PaginationOptions | None = None
-    ) -> tuple[list[RelationTuple], str]:
-        pagination = pagination or PaginationOptions()
-        offset = decode_page_token(pagination.token)
-        per_page = pagination.per_page
-        if self.namespace_manager is not None and query.namespace is not None:
-            self.namespace_manager.get_namespace_by_name(query.namespace)
-        where, params = self._where(query)
-        with self._lock:
-            rows = self._conn.execute(
-                "SELECT namespace, object, relation, subject_id, "
-                "subject_set_namespace, subject_set_object, subject_set_relation "
-                f"FROM keto_relation_tuples WHERE {where} "
-                "ORDER BY seq LIMIT ? OFFSET ?",
-                params + [per_page + 1, offset],
-            ).fetchall()
-        has_more = len(rows) > per_page
-        page = [_row_to_tuple(r) for r in rows[:per_page]]
-        next_token = encode_page_token(offset + per_page) if has_more else ""
-        return page, next_token
-
-    def _insert_locked(self, t: RelationTuple) -> bool:
-        sid, sns, sobj, srel = _subject_columns(t)
-        cur = self._conn.execute(
-            "INSERT OR IGNORE INTO keto_relation_tuples "
-            "(shard_id, nid, namespace, object, relation, subject_id, "
-            "subject_set_namespace, subject_set_object, subject_set_relation, "
-            "commit_time) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
-            (
-                str(uuid.uuid4()),
-                self.network_id,
-                t.namespace,
-                t.object,
-                t.relation,
-                sid,
-                sns,
-                sobj,
-                srel,
-                time.time(),
-            ),
-        )
-        return cur.rowcount > 0
-
-    def _delete_locked(self, t: RelationTuple) -> bool:
-        where, params = self._where(t.to_query())
-        cur = self._conn.execute(
-            f"DELETE FROM keto_relation_tuples WHERE {where}", params
-        )
-        return cur.rowcount > 0
-
-    def write_relation_tuples(self, *tuples: RelationTuple) -> None:
-        for t in tuples:
-            self._validate(t)
-        with self._lock, self._conn:
-            fresh = [t for t in tuples if self._insert_locked(t)]
-            v = self._bump_locked()
-        self._notify(v, inserted=fresh)
-
-    def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
-        with self._lock, self._conn:
-            gone = [t for t in tuples if self._delete_locked(t)]
-            v = self._bump_locked()
-        self._notify(v, deleted=gone)
-
-    def delete_all_relation_tuples(self, query: RelationQuery) -> None:
-        where, params = self._where(query)
-        with self._lock, self._conn:
-            rows = self._conn.execute(
-                "SELECT namespace, object, relation, subject_id, "
-                "subject_set_namespace, subject_set_object, subject_set_relation "
-                f"FROM keto_relation_tuples WHERE {where} ORDER BY seq",
-                params,
-            ).fetchall()
-            self._conn.execute(
-                f"DELETE FROM keto_relation_tuples WHERE {where}", params
-            )
-            v = self._bump_locked()
-        self._notify(v, deleted=[_row_to_tuple(r) for r in rows])
-
-    def transact_relation_tuples(
-        self,
-        insert: Sequence[RelationTuple],
-        delete: Sequence[RelationTuple],
-    ) -> None:
-        for t in insert:
-            self._validate(t)
-        with self._lock, self._conn:
-            fresh = [t for t in insert if self._insert_locked(t)]
-            gone = [t for t in delete if self._delete_locked(t)]
-            v = self._bump_locked()
-        self._notify(v, inserted=fresh, deleted=gone)
-
-    # -- snapshot support ------------------------------------------------------
-
-    def all_tuples(self) -> list[RelationTuple]:
-        with self._lock:
-            rows = self._conn.execute(
-                "SELECT namespace, object, relation, subject_id, "
-                "subject_set_namespace, subject_set_object, subject_set_relation "
-                "FROM keto_relation_tuples WHERE nid = ? ORDER BY seq",
-                (self.network_id,),
-            ).fetchall()
-        return [_row_to_tuple(r) for r in rows]
-
-    def snapshot(self) -> tuple[list[RelationTuple], int]:
-        with self._lock:
-            return self.all_tuples(), self.version
-
-    def __len__(self) -> int:
-        with self._lock:
-            return self._conn.execute(
-                "SELECT COUNT(*) FROM keto_relation_tuples WHERE nid = ?",
-                (self.network_id,),
-            ).fetchone()[0]
-
-    def close(self) -> None:
-        with self._lock:
-            self._conn.close()

@@ -31,10 +31,13 @@ def nsmgr():
     return MemoryNamespaceManager()
 
 
-@pytest.fixture(params=["memory", "sqlite", "columnar"])
+@pytest.fixture(params=["memory", "sqlite", "columnar", "postgres"])
 def store(request, nsmgr, tmp_path):
     """Every contract/engine test runs against all persistence backends —
-    the reference's one-suite-many-DSNs matrix (SURVEY.md §4)."""
+    the reference's one-suite-many-DSNs matrix (SURVEY.md §4). The postgres
+    leg runs only when KETO_TEST_PG_DSN points at a live server AND a
+    psycopg driver exists (the reference's equivalent: -short skips its
+    dockertest engines, internal/x/dbx/dsn_testutils.go:36-43)."""
     if request.param == "memory":
         yield InMemoryTupleStore(namespace_manager=nsmgr)
         return
@@ -42,6 +45,24 @@ def store(request, nsmgr, tmp_path):
         from keto_tpu.store import ColumnarTupleStore
 
         yield ColumnarTupleStore(namespace_manager=nsmgr)
+        return
+    if request.param == "postgres":
+        dsn = os.environ.get("KETO_TEST_PG_DSN")
+        if not dsn:
+            pytest.skip("postgres: set KETO_TEST_PG_DSN to run")
+        from keto_tpu.persistence.postgres import PostgresTupleStore
+
+        try:
+            s = PostgresTupleStore(dsn, namespace_manager=nsmgr)
+        except Exception as e:
+            # no driver (RuntimeError) or unreachable server (driver's
+            # OperationalError): a visible skip, not a matrix-wide error
+            pytest.skip(f"postgres backend unavailable: {e}")
+        yield s
+        from keto_tpu.relationtuple import RelationQuery
+
+        s.delete_all_relation_tuples(RelationQuery())
+        s.close()
         return
     from keto_tpu.persistence import SQLiteTupleStore
 

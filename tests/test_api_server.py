@@ -95,6 +95,31 @@ class TestRest:
         with rest(clean, "write") as c:
             assert c.get("/health/alive").status_code == 200
 
+    def test_list_and_expand_snaptoken_validation(self, clean):
+        """REST twins of the gRPC snaptoken fields: accepted when valid,
+        400 when malformed (keto_tpu extension; the reference has none)."""
+        with rest(clean) as c:
+            r = c.get(
+                "/relation-tuples",
+                params={"namespace": "n", "snaptoken": "1"},
+            )
+            assert r.status_code == 200
+            r = c.get(
+                "/relation-tuples",
+                params={"namespace": "n", "snaptoken": "bogus"},
+            )
+            assert r.status_code == 400
+            r = c.get(
+                "/expand",
+                params={
+                    "namespace": "n",
+                    "object": "o",
+                    "relation": "r",
+                    "snaptoken": "bogus",
+                },
+            )
+            assert r.status_code == 400
+
     def test_create_check_expand_flow(self, clean):
         with rest(clean, "write") as w:
             r = w.put(
@@ -376,6 +401,94 @@ class TestGrpc:
                 )
             )
             assert len(lst.relation_tuples) == 2
+
+    def test_list_snaptoken_and_expand_mask(self, clean):
+        """ListRelationTuples honors snaptoken (validated; live-store reads
+        are always at least as fresh) and implements expand_mask projection
+        — both fields the reference ignores (read_service.proto:22-23)."""
+        with grpc_channel(clean, "write") as wch:
+            WriteServiceStub(wch).TransactRelationTuples(
+                write_service_pb2.TransactRelationTuplesRequest(
+                    relation_tuple_deltas=[
+                        write_service_pb2.RelationTupleDelta(
+                            action=write_service_pb2.RelationTupleDelta.INSERT,
+                            relation_tuple=acl_pb2.RelationTuple(
+                                namespace="n", object="o", relation="r",
+                                subject=acl_pb2.Subject(id="alice"),
+                            ),
+                        )
+                    ]
+                )
+            )
+        with grpc_channel(clean) as rch:
+            read = ReadServiceStub(rch)
+            q = read_service_pb2.ListRelationTuplesRequest.Query(
+                namespace="n"
+            )
+            # snaptoken from a write is honored (trivially fresh here)
+            lst = read.ListRelationTuples(
+                read_service_pb2.ListRelationTuplesRequest(
+                    query=q, snaptoken="1"
+                )
+            )
+            assert len(lst.relation_tuples) == 1
+            # malformed snaptoken -> INVALID_ARGUMENT
+            with pytest.raises(grpc.RpcError) as e:
+                read.ListRelationTuples(
+                    read_service_pb2.ListRelationTuplesRequest(
+                        query=q, snaptoken="not-a-version"
+                    )
+                )
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            # expand_mask projects the returned tuples
+            req = read_service_pb2.ListRelationTuplesRequest(query=q)
+            req.expand_mask.paths.extend(["namespace", "object"])
+            lst = read.ListRelationTuples(req)
+            t0 = lst.relation_tuples[0]
+            assert t0.namespace == "n" and t0.object == "o"
+            assert t0.relation == "" and not t0.HasField("subject")
+            # unknown mask path -> INVALID_ARGUMENT
+            bad = read_service_pb2.ListRelationTuplesRequest(query=q)
+            bad.expand_mask.paths.append("commit_time")
+            with pytest.raises(grpc.RpcError) as e:
+                read.ListRelationTuples(bad)
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_expand_snaptoken(self, clean):
+        """ExpandRequest.snaptoken: honored (expand reads the live
+        snapshot) and validated."""
+        with grpc_channel(clean, "write") as wch:
+            WriteServiceStub(wch).TransactRelationTuples(
+                write_service_pb2.TransactRelationTuplesRequest(
+                    relation_tuple_deltas=[
+                        write_service_pb2.RelationTupleDelta(
+                            action=write_service_pb2.RelationTupleDelta.INSERT,
+                            relation_tuple=acl_pb2.RelationTuple(
+                                namespace="n", object="doc", relation="view",
+                                subject=acl_pb2.Subject(id="bob"),
+                            ),
+                        )
+                    ]
+                )
+            )
+        subject = acl_pb2.Subject(
+            set=acl_pb2.SubjectSet(namespace="n", object="doc", relation="view")
+        )
+        with grpc_channel(clean) as rch:
+            expand = ExpandServiceStub(rch)
+            t = expand.Expand(
+                expand_service_pb2.ExpandRequest(
+                    subject=subject, snaptoken="1"
+                )
+            )
+            assert t.tree.node_type == expand_service_pb2.NODE_TYPE_UNION
+            with pytest.raises(grpc.RpcError) as e:
+                expand.Expand(
+                    expand_service_pb2.ExpandRequest(
+                        subject=subject, snaptoken="xyz"
+                    )
+                )
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
 
     def test_check_without_subject_invalid(self, clean):
         with grpc_channel(clean) as rch:

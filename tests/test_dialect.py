@@ -1,0 +1,97 @@
+"""Dialect split: engine-specific SQL generation and migration overlays
+(reference per-dialect persistence, internal/persistence/sql/persister.go:50-51,
+internal/x/dbx/dsn_testutils.go:22-74)."""
+
+import os
+
+import pytest
+
+from keto_tpu.persistence import (
+    DIALECTS,
+    PostgresDialect,
+    SQLiteDialect,
+    dialect_for_dsn,
+)
+from keto_tpu.persistence.migrator import load_migrations
+from keto_tpu.persistence.sqlstore import _MIGRATIONS_DIR
+
+
+class TestDialects:
+    def test_placeholder_rewrite(self):
+        pg = PostgresDialect()
+        assert pg.sql("SELECT * FROM t WHERE a = ? AND b = ?") == (
+            "SELECT * FROM t WHERE a = %s AND b = %s"
+        )
+        sq = SQLiteDialect()
+        assert sq.sql("a = ?") == "a = ?"
+
+    def test_insert_ignore_spellings(self):
+        cols = ("a", "b")
+        assert "INSERT OR IGNORE" in SQLiteDialect().insert_ignore("t", cols)
+        pg = PostgresDialect().insert_ignore("t", cols)
+        assert "ON CONFLICT DO NOTHING" in pg and "INSERT INTO t" in pg
+
+    def test_dsn_dispatch(self):
+        d, native = dialect_for_dsn("memory")
+        assert d.name == "sqlite" and native == ":memory:"
+        d, native = dialect_for_dsn("sqlite:///tmp/x.db")
+        assert d.name == "sqlite" and native == "/tmp/x.db"
+        d, native = dialect_for_dsn("postgres://u:p@h/db")
+        assert d.name == "postgres" and native == "postgres://u:p@h/db"
+        with pytest.raises(ValueError):
+            dialect_for_dsn("mongodb://nope")
+
+    def test_postgres_connect_without_driver_raises_clearly(self):
+        has_driver = True
+        try:
+            import psycopg  # noqa: F401
+        except ImportError:
+            try:
+                import psycopg2  # noqa: F401
+            except ImportError:
+                has_driver = False
+        if has_driver:
+            pytest.skip("a postgres driver exists in this image")
+        with pytest.raises(RuntimeError, match="no postgres driver"):
+            PostgresDialect().connect("postgres://localhost/x")
+
+
+class TestMigrationOverlays:
+    def test_postgres_overlay_replaces_generic(self):
+        generic = {
+            m.version: m for m in load_migrations(_MIGRATIONS_DIR)
+        }
+        pg = {
+            m.version: m
+            for m in load_migrations(
+                _MIGRATIONS_DIR, dialect=DIALECTS["postgres"]
+            )
+        }
+        assert set(pg) == set(generic)  # same version ladder
+        v0 = "20220101000000"
+        assert "AUTOINCREMENT" in generic[v0].up_sql
+        assert "BIGSERIAL" in pg[v0].up_sql
+        # no postgres down overlay: the generic down carries over
+        assert pg[v0].down_sql == generic[v0].down_sql
+        # portable migrations identical on both
+        v1 = "20220101000001"
+        assert pg[v1].up_sql == generic[v1].up_sql
+
+    def test_sqlite_dialect_sees_generic_files_only(self):
+        sq = {
+            m.version: m
+            for m in load_migrations(
+                _MIGRATIONS_DIR, dialect=DIALECTS["sqlite"]
+            )
+        }
+        assert "AUTOINCREMENT" in sq["20220101000000"].up_sql
+
+    def test_overlay_file_naming_is_complete(self):
+        """Every *.postgres.*.sql has a generic twin (else a dialect would
+        silently gain a migration others lack)."""
+        for fname in os.listdir(_MIGRATIONS_DIR):
+            if ".postgres." in fname:
+                twin = fname.replace(".postgres.", ".")
+                assert os.path.exists(
+                    os.path.join(_MIGRATIONS_DIR, twin)
+                ), f"{fname} has no generic twin {twin}"

@@ -179,6 +179,29 @@ def main():
           f"lookup_bulk={ms_lookup:.2f} check_arrays={ms_arrays:.2f}",
           flush=True)
 
+    # ---- expand p50/p95 over the bench's root sample
+    from keto_tpu.engine.device import SnapshotExpandEngine
+
+    expander = SnapshotExpandEngine(snapshots, max_depth=5)
+    exp_lat = []
+    n_nodes = []
+
+    def count(tree):
+        return 1 + sum(count(c) for c in tree.children)
+
+    for key in _roots:
+        subject = SubjectSet(namespace=key[0], object=key[1], relation=key[2])
+        t0 = time.perf_counter()
+        tree = expander.build_tree(subject, max_depth=3)
+        exp_lat.append(time.perf_counter() - t0)
+        n_nodes.append(0 if tree is None else count(tree))
+    print(f"expand: p50={np.percentile(exp_lat,50)*1e3:.2f}ms "
+          f"p95={np.percentile(exp_lat,95)*1e3:.2f}ms "
+          f"max={max(exp_lat)*1e3:.1f}ms "
+          f"nodes_p50={int(np.percentile(n_nodes,50))} "
+          f"nodes_p95={int(np.percentile(n_nodes,95))} "
+          f"nodes_max={max(n_nodes)}", flush=True)
+
     from keto_tpu import native
     print(f"native={native.available()}", flush=True)
 
