@@ -213,6 +213,18 @@ def _as_obj(arr) -> np.ndarray:
 
 
 def run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_kind: str):
+    import gc as _gc
+
+    try:
+        return _run_config(name, n_tuples, gen, batch, iters, engine_kind)
+    finally:
+        # release this config's frozen graph so the next config's GC and
+        # RSS aren't polluted by an unreclaimable previous store
+        _gc.unfreeze()
+        _gc.collect()
+
+
+def _run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_kind: str):
     from keto_tpu.engine.device import DeviceCheckEngine, SnapshotExpandEngine
     from keto_tpu.engine.closure import ClosureCheckEngine, _ClosureArtifacts
     from keto_tpu.graph import SnapshotManager
@@ -312,7 +324,15 @@ def run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_kin
             enc_rps = max(enc_rps, batch * iters / (time.time() - t0))
         gc.enable()
 
-    # expand: host tree walk over the resident CSR
+    # expand: host tree walk over the resident CSR. Freeze the resident
+    # graph out of the cyclic GC first, exactly like the serving registry
+    # does at boot (registry.start_all): tree construction allocates
+    # thousands of tracked objects per call, and a gen2 collection over
+    # the tens-of-millions-object store otherwise lands inside random
+    # expands as a multi-second p95 outlier. Unfrozen in run_config's
+    # finally so one config's dead objects don't become unreclaimable
+    # ballast in the NEXT config's RSS numbers.
+    gc.freeze()
     expander = SnapshotExpandEngine(snapshots, max_depth=5)
     exp_lat = []
     for key in expand_roots:
@@ -349,6 +369,13 @@ def run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_kin
         meta["freshness"] = engine.freshness
     print(json.dumps(meta), file=sys.stderr, flush=True)
 
+    if os.environ.get("BENCH_WRITES", "1") == "1" and hasattr(
+        engine, "wait_for_version"
+    ):
+        writes_meta = run_write_bench(name, store, engine, sample, to_requests)
+        meta.update(writes_meta)
+        print(json.dumps(writes_meta), file=sys.stderr, flush=True)
+
     if os.environ.get("BENCH_SERVER", "1") == "1":
         server_meta = run_server_bench(
             name, store, snapshots, engine, sample, to_requests
@@ -356,6 +383,92 @@ def run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_kin
         meta.update(server_meta)
         print(json.dumps(server_meta), file=sys.stderr, flush=True)
     return meta
+
+
+def run_write_bench(name, store, engine, sample, to_requests):
+    """Freshness under writes (VERDICT r3 #3): interleave inserts+deletes
+    with checks and measure write->fresh-answer staleness. Leaf writes ride
+    the serving-time overlay (engine/overlay.py); a few interior-edge
+    inserts exercise the in-place O(M^2) closure patch. Reports staleness
+    percentiles, snaptoken-wait 503s (must be 0), whether any write forced
+    a closure rebuild, and sustained check RPS during the write phase."""
+    from keto_tpu.relationtuple import RelationTuple, SubjectSet
+    from keto_tpu.utils.errors import ErrUnavailable
+
+    rng = np.random.default_rng(23)
+    cycles = int(os.environ.get("BENCH_WRITE_CYCLES", 12))
+    batch = 1024
+    stale_ms: list = []
+    n_503 = 0
+    n_checks = 0
+    n_wrong = 0
+    builds0 = engine.n_full_builds + engine.n_incremental_builds
+    check_batches = [to_requests(*sample(rng, batch)) for _ in range(4)]
+    t_phase = time.time()
+    for cycle in range(cycles):
+        fresh = [
+            RelationTuple(
+                namespace="rbac",
+                object=f"res{rng.integers(50)}",
+                relation="view",
+                subject=SubjectSet(
+                    namespace="rbac", object=f"g{rng.integers(20)}",
+                    relation="member",
+                ),
+            ),
+            RelationTuple(
+                namespace="rbac",
+                object=f"wr{cycle}",
+                relation="view",
+                subject=SubjectSet(
+                    namespace="rbac", object=f"wg{cycle}", relation="member"
+                ),
+            ),
+        ]
+        if cycle % 4 == 0:
+            # interior edge: an existing group gains a nested group
+            fresh.append(
+                RelationTuple(
+                    namespace="rbac",
+                    object=f"g{rng.integers(20)}",
+                    relation="member",
+                    subject=SubjectSet(
+                        namespace="rbac", object=f"wg{cycle}",
+                        relation="member",
+                    ),
+                )
+            )
+        for op, tuples in (("ins", fresh), ("del", fresh[:1])):
+            t0 = time.perf_counter()
+            if op == "ins":
+                store.write_relation_tuples(*tuples)
+            else:
+                store.delete_relation_tuples(*tuples)
+            try:
+                engine.wait_for_version(store.version, timeout_s=30.0)
+            except ErrUnavailable:
+                n_503 += 1
+            stale_ms.append(1000 * (time.perf_counter() - t0))
+            # correctness probe: the written/deleted tuple itself
+            got = engine.subject_is_allowed(tuples[0], 1)
+            if got != (op == "ins"):
+                n_wrong += 1
+            allowed = engine.batch_check(check_batches[cycle % 4])
+            n_checks += len(allowed)
+    elapsed = time.time() - t_phase
+    return {
+        "config": f"{name}_writes",
+        "write_cycles": cycles,
+        "staleness_p50_ms": round(float(np.percentile(stale_ms, 50)), 2),
+        "staleness_p95_ms": round(float(np.percentile(stale_ms, 95)), 2),
+        "staleness_max_ms": round(float(max(stale_ms)), 2),
+        "snaptoken_503s": n_503,
+        "wrong_answers": n_wrong,
+        "closure_rebuilds": (
+            engine.n_full_builds + engine.n_incremental_builds - builds0
+        ),
+        "check_rps_during_writes": round(n_checks / elapsed),
+    }
 
 
 # ---------------------------------------------------------------------------

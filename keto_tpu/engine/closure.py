@@ -66,6 +66,7 @@ from ..ops.closure import (
 )
 from ..relationtuple.definitions import RelationTuple, SubjectID, SubjectSet
 from .check import DEFAULT_MAX_DEPTH, CheckEngine, clamp_depth
+from .overlay import WriteOverlay
 
 from ..graph.snapshot import _bucket
 
@@ -83,6 +84,10 @@ _MAX_INCR_EDGES = 8
 # rows whose F0 and L fan-outs both fit this width take the narrow gather
 # path; the heavy tail is processed separately at full width
 _NARROW_WIDTH = 8
+
+# spare D rows reserved for overlay-grown interior nodes (new subject sets
+# gaining their first in-edge) between rebuilds
+_GROW_RESERVE = 512
 
 
 def _bucket_pow2(n: int, minimum: int = _MIN_BATCH) -> int:
@@ -121,8 +126,11 @@ class _ClosureArtifacts:
         self.snap = snap
         self.ig = ig
         self.k_max = k_max
-        # pad so at least one INF row exists (the PAD index target)
-        self.m_pad = _bucket_mult(ig.m + 1, 256)
+        # pad past the live interior: at least one INF row (the PAD index
+        # target) plus real headroom the write overlay can grow new
+        # interior nodes into without forcing a rebuild (engine/overlay.py
+        # _grow_interior). ~2% more D memory at the 100M-tuple scale.
+        self.m_pad = _bucket_mult(ig.m + 1 + _GROW_RESERVE, 256)
         self.pad = self.m_pad - 1
         if d is None and d_host is None:
             packed = pack_adjacency(ig.ii_src, ig.ii_dst, self.m_pad)
@@ -135,9 +143,15 @@ class _ClosureArtifacts:
         if host:
             # one D download per snapshot, then the hot path never touches
             # the device; the device copy is dropped (it would double the
-            # per-snapshot footprint, ~m_pad^2 bytes each)
+            # per-snapshot footprint, ~m_pad^2 bytes each). The host copy
+            # must be WRITABLE (np.asarray of a device array is a read-only
+            # view): the write overlay patches it in place.
             self.d = None
-            self.d_host = np.asarray(d) if d_host is None else d_host
+            if d_host is None:
+                d_host = np.asarray(d)
+                if not d_host.flags.writeable:
+                    d_host = d_host.copy()
+            self.d_host = d_host
         else:
             self.d = d
             self.d_host = None
@@ -203,6 +217,29 @@ class ClosureCheckEngine:
         self._state: Optional[_State] = None
         self._rebuilding = False
         self._fallback = fallback
+        # write overlay: exact serving-time deltas over the resident
+        # closure (engine/overlay.py). Subscribed to the store's delta
+        # feed; weak so dead engines neither leak nor tax the write path.
+        self._overlay: Optional[WriteOverlay] = None
+        self._delta_cb = None
+        subscribe = getattr(snapshots.store, "subscribe_deltas", None)
+        if subscribe is not None:
+            import weakref
+
+            ref = weakref.ref(self)
+            store = snapshots.store
+
+            def _cb(version, inserted, deleted, _ref=ref, _store=store):
+                eng = _ref()
+                if eng is None:
+                    unsub = getattr(_store, "unsubscribe_deltas", None)
+                    if unsub is not None:
+                        unsub(_cb)
+                    return
+                eng._on_delta(version, inserted, deleted)
+
+            self._delta_cb = _cb
+            subscribe(_cb)
         # build telemetry (read by tests and the metrics endpoint)
         self.n_full_builds = 0
         self.n_incremental_builds = 0
@@ -225,6 +262,40 @@ class ClosureCheckEngine:
         else:
             self._m_checks = self._m_batch_s = self._m_builds = None
 
+    # -- write overlay ---------------------------------------------------------
+
+    def _on_delta(self, version, inserted, deleted) -> None:
+        """Store delta feed (writer thread): cheap enqueue onto the live
+        overlay; classification happens on the next query's drain."""
+        ov = self._overlay
+        if ov is not None:
+            ov.enqueue(version, inserted, deleted)
+        with self._state_cv:
+            self._state_cv.notify_all()  # freshness waiters re-check
+
+    def _pin_overlay(self, state) -> Optional[WriteOverlay]:
+        """Pin the overlay for one batch. The SAME object must serve the
+        whole batch: re-resolving self._overlay mid-batch could swap in a
+        new generation (compaction rebuild) and silently drop the
+        corrections _serving promised. A pinned overlay stays usable even
+        if a later delta breaks it — the two-phase apply keeps a broken
+        overlay consistent at its last covered version."""
+        if not isinstance(state, _ClosureArtifacts):
+            return None
+        ov = self._overlay
+        if ov is None or ov.art is not state:
+            return None
+        ov.drain()
+        if ov.n_events == 0:
+            return None
+        if ov.broken:
+            self._kick_rebuild()
+        elif ov.n_events > ov.max_events // 2:
+            # proactive compaction: fold a large overlay back into a fresh
+            # closure in the background while the overlay keeps serving
+            self._kick_rebuild()
+        return ov
+
     # -- residency ------------------------------------------------------------
 
     def host_queries(self) -> bool:
@@ -242,9 +313,16 @@ class ClosureCheckEngine:
     def served_version(self) -> int:
         """The store version checks are currently answered at. Equals the
         live store version except in bounded freshness mid-rebuild, where it
-        names the (older) snapshot still serving — the honest snaptoken."""
+        names the (older) snapshot still serving — the honest snaptoken.
+        An active write overlay advances this to the live version without
+        any rebuild (its corrections are exact)."""
         state = self._state
         if isinstance(state, _ClosureArtifacts):
+            ov = self._overlay
+            if ov is not None and ov.art is state:
+                ov.drain()
+                if not ov.broken:
+                    return ov.version
             return state.version
         return self.snapshots.store.version
 
@@ -259,6 +337,12 @@ class ClosureCheckEngine:
         store_version = self.snapshots.store.version
         if state is not None and state.version == store_version:
             return store_version
+        if isinstance(state, _ClosureArtifacts):
+            ov = self._overlay
+            if ov is not None and ov.art is state:
+                ov.drain()
+                if ov.active(store_version):
+                    return ov.version  # overlay-corrected: live-exact
         if self._bounded(state) and isinstance(state, _ClosureArtifacts):
             # serving stale while rebuilding — and the rebuild must be
             # kicked HERE too: a result cache that answers hits without
@@ -280,13 +364,23 @@ class ClosureCheckEngine:
         return state.num_edges >= self.strong_freshness_edges
 
     def _serving(self) -> _State:
-        """The state answering this check — fresh, or stale-with-rebuild
-        under bounded freshness. Never stalls on a rebuild once a state
-        exists and the policy is bounded."""
+        """The state answering this check — fresh, overlay-corrected (exact
+        at the live version, no rebuild), or stale-with-rebuild under
+        bounded freshness. Never stalls on a rebuild once a state exists
+        and the policy is bounded."""
         state = self._state
         store_version = self.snapshots.store.version
         if state is not None and state.version == store_version:
             return state
+        if isinstance(state, _ClosureArtifacts):
+            ov = self._overlay
+            if ov is not None and ov.art is state:
+                ov.drain()
+                if ov.active(self.snapshots.store.version):
+                    # every write since the snapshot is absorbed: serve the
+                    # resident closure + overlay corrections — exact at the
+                    # live version under ANY freshness policy
+                    return state
         if self._bounded(state):
             self._kick_rebuild()
             return state
@@ -303,6 +397,15 @@ class ClosureCheckEngine:
             with self.tracer.span("snapshot.encode"):
                 snap = self.snapshots.snapshot()
             state = self._build_state(snap, prev=self._state)
+            if isinstance(state, _ClosureArtifacts):
+                # fresh overlay generation for the new residency. A delta
+                # racing this swap may land on the outgoing overlay and be
+                # missed here; the new overlay then sees a version gap and
+                # marks itself broken — a conservative rebuild, never a
+                # wrong answer.
+                self._overlay = WriteOverlay(state)
+            else:
+                self._overlay = None
             self._state = state
             with self._state_cv:
                 self._state_cv.notify_all()  # wake wait_for_version
@@ -482,6 +585,11 @@ class ClosureCheckEngine:
                 return  # fallback/first-build paths answer from live data
             if state.version >= target:
                 return
+            ov = self._overlay
+            if ov is not None and ov.art is state:
+                ov.drain()
+                if not ov.broken and ov.version >= target:
+                    return  # overlay absorbs the writes: already fresh
             if not self._bounded(state):
                 return  # strong freshness: the check itself rebuilds
             if not kicked:
@@ -515,8 +623,6 @@ class ClosureCheckEngine:
         art = state
         snap = art.snap
         n = len(requests)
-        pn = snap.padded_nodes
-        dummy = snap.dummy_node
 
         # ---- encode: requests -> node ids. Fast path hashes the key
         # tuples straight off the request objects in one C loop
@@ -555,8 +661,6 @@ class ClosureCheckEngine:
             is_id = np.fromiter(
                 (len(k) == 1 for k in tkeys), dtype=bool, count=n
             )
-        start = np.where((s_ids < 0) | (s_ids >= pn), dummy, s_ids)
-        target = np.where((t_ids < 0) | (t_ids >= pn), dummy, t_ids)
 
         gmax = self.global_max_depth
         if depths is not None:
@@ -568,7 +672,8 @@ class ClosureCheckEngine:
         )
 
         allowed = self._check_arrays(
-            snap, art, start, target, is_id, depth, requests
+            snap, art, s_ids, t_ids, is_id, depth,
+            self._pin_overlay(art), requests
         )
         if self._m_checks is not None:
             self._m_checks.inc(n)
@@ -618,13 +723,9 @@ class ClosureCheckEngine:
             return res
         art = state
         snap = art.snap
-        # ids interned after this snapshot (or by a caller on a newer one)
-        # are unknown here: clamp to the inert dummy node
-        start = np.where(start >= snap.padded_nodes, snap.dummy_node, start)
-        target = np.where(
-            target >= snap.padded_nodes, snap.dummy_node, target
+        return self._check_arrays(
+            snap, art, start, target, is_id, depth, self._pin_overlay(art)
         )
-        return self._check_arrays(snap, art, start, target, is_id, depth)
 
     def _decode_requests(self, snap, start, target) -> list[RelationTuple]:
         """ids -> RelationTuples (overflow/fallback paths only)."""
@@ -652,25 +753,36 @@ class ClosureCheckEngine:
         self,
         snap,
         art,
-        start,
-        target,
+        start_raw,
+        target_raw,
         is_id,
         depth,
+        pinned_overlay: Optional[WriteOverlay] = None,
         requests: Optional[Sequence[RelationTuple]] = None,
     ) -> np.ndarray:
-        n = len(start)
+        """`start_raw`/`target_raw` are RAW vocab ids (possibly -1 unknown
+        or beyond this snapshot's width): the base path clamps them to the
+        inert dummy node, while the write-overlay correction needs the real
+        ids to see edges on nodes interned after the snapshot."""
+        n = len(start_raw)
         ig = art.ig
+        pn = snap.padded_nodes
+        dummy = snap.dummy_node
         # process rows sorted by start id: requests sharing a start (or
         # nearby starts) then gather the same F0/indptr/closure rows
         # back-to-back, which turns the batch's random walk over the
         # hundreds-of-MB closure/CSR arrays into mostly-cached re-reads —
         # measured ~3x on the 30M-tuple array path. Results are scattered
         # back to request order at the end.
-        order = np.argsort(start, kind="stable")
-        start = start[order]
-        target = target[order]
+        order = np.argsort(start_raw, kind="stable")
+        start_raw = start_raw[order]
+        target_raw = target_raw[order]
         is_id = is_id[order]
         depth = depth[order]
+        start = np.where((start_raw < 0) | (start_raw >= pn), dummy, start_raw)
+        target = np.where(
+            (target_raw < 0) | (target_raw >= pn), dummy, target_raw
+        )
 
         from .. import native
 
@@ -680,6 +792,9 @@ class ClosureCheckEngine:
             # row (no width caps, hence no oracle fallback on this path)
             allowed = native.closure_check(
                 art.d_host, ig, start, target, is_id, depth
+            )
+            allowed = self._apply_overlay(
+                pinned_overlay, allowed, start_raw, target_raw, is_id, depth
             )
             out = np.empty(n, dtype=bool)
             out[order] = allowed
@@ -736,9 +851,42 @@ class ClosureCheckEngine:
             )
             for i, v in zip(idxs, res):
                 allowed[i] = v
+        allowed = self._apply_overlay(
+            pinned_overlay,
+            allowed,
+            start_raw,
+            target_raw,
+            is_id,
+            depth,
+            skip=overflow,  # oracle rows read the live store: already exact
+        )
         out = np.empty(n, dtype=bool)
         out[order] = allowed
         return out
+
+    def _apply_overlay(
+        self,
+        ov: Optional[WriteOverlay],
+        allowed: np.ndarray,
+        start_raw: np.ndarray,
+        target_raw: np.ndarray,
+        is_id: np.ndarray,
+        depth: np.ndarray,
+        skip: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Correct the (few) rows the pinned write overlay says may differ
+        from the base closure answer — exact at the overlay's version."""
+        if ov is None:
+            return allowed
+        mask = ov.affected_rows(start_raw, target_raw, is_id)
+        if skip is not None:
+            mask &= ~skip
+        if mask.any():
+            allowed = allowed.copy() if allowed.base is not None else allowed
+            allowed[mask] = ov.check_rows(
+                start_raw[mask], target_raw[mask], is_id[mask], depth[mask]
+            )
+        return allowed
 
     def _query_rows(
         self, art, ig, start, target, is_id, depth, direct

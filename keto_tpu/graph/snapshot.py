@@ -59,6 +59,14 @@ class GraphSnapshot:
     _csr: Optional[tuple[np.ndarray, np.ndarray]] = field(
         default=None, repr=False, compare=False
     )
+    # edges covered by _csr (deriving sets it to num_edges; an incremental
+    # append carries the previous snapshot's CSR forward with a smaller
+    # coverage plus the appended successors in _csr_extra, so a write does
+    # NOT cost the O(E log E) re-sort on the next expand)
+    _csr_edges: int = field(default=0, repr=False, compare=False)
+    _csr_extra: Optional[dict] = field(
+        default=None, repr=False, compare=False
+    )
 
     @property
     def dummy_node(self) -> int:
@@ -83,8 +91,11 @@ class GraphSnapshot:
 
     def csr(self) -> tuple[np.ndarray, np.ndarray]:
         """(indptr int32[padded_nodes+1], indices int32[padded_edges]) sorted
-        by source; derived on demand and cached."""
-        if self._csr is None:
+        by source over ALL live edges; derived on demand and cached. A
+        carried-forward partial CSR (incremental appends) is replaced by a
+        full derive here — out_neighbors() prefers the carried CSR plus the
+        append deltas and never forces this."""
+        if self._csr is None or self._csr_edges != self.num_edges:
             s = self.src[: self.num_edges]
             d = self.dst[: self.num_edges]
             order = np.argsort(s, kind="stable")
@@ -94,13 +105,30 @@ class GraphSnapshot:
             indices = np.full(self.padded_edges, self.dummy_node, dtype=np.int32)
             indices[: self.num_edges] = d[order]
             self._csr = (indptr, indices)
+            self._csr_edges = self.num_edges
+            self._csr_extra = None
         return self._csr
 
     def out_neighbors(self, nid: int) -> np.ndarray:
-        """Successor node ids of `nid` (host-side traversal, e.g. expand)."""
-        indptr, indices = self.csr()
+        """Successor node ids of `nid`, in insertion order (host-side
+        traversal, e.g. expand)."""
         if nid >= self.padded_nodes:
             return np.empty(0, dtype=np.int32)
+        if (
+            self._csr is not None
+            and self._csr_edges < self.num_edges
+            and self._csr_extra is not None
+        ):
+            # carried CSR + appended successors: no O(E log E) re-derive
+            indptr, indices = self._csr
+            base = indices[indptr[nid] : indptr[nid + 1]]
+            extra = self._csr_extra.get(nid)
+            if extra:
+                return np.concatenate(
+                    [base, np.asarray(extra, dtype=np.int32)]
+                )
+            return base
+        indptr, indices = self.csr()
         return indices[indptr[nid] : indptr[nid + 1]]
 
 
@@ -280,6 +308,29 @@ class SnapshotManager:
             dst = snap.dst.copy()
             src[snap.num_edges : e_new] = src_ids
             dst[snap.num_edges : e_new] = dst_ids
+            # carry the derived CSR forward with the appended edges as an
+            # extra-successors delta: expand after a write must not pay the
+            # O(E log E) CSR re-sort (~30s at 100M edges). Bounded: past
+            # the cap the carry is dropped and the next expand re-derives.
+            csr = csr_edges = csr_extra = None
+            if snap._csr is not None:
+                prev_extra = snap._csr_extra
+                if snap._csr_edges == snap.num_edges:
+                    prev_extra = {}  # fully-covered CSR: fresh delta
+                if prev_extra is not None and len(prev_extra) < 4096:
+                    csr = snap._csr
+                    csr_edges = (
+                        snap._csr_edges
+                        if snap._csr_edges < snap.num_edges
+                        else snap.num_edges
+                    )
+                    csr_extra = {
+                        k: list(v) for k, v in prev_extra.items()
+                    }
+                    for s_id, d_id in zip(src_ids, dst_ids):
+                        csr_extra.setdefault(int(s_id), []).append(
+                            int(d_id)
+                        )
             self._snap = GraphSnapshot(
                 vocab=vocab,
                 src=src,
@@ -289,4 +340,7 @@ class SnapshotManager:
                 padded_nodes=snap.padded_nodes,
                 padded_edges=snap.padded_edges,
                 version=version,
+                _csr=csr,
+                _csr_edges=csr_edges or 0,
+                _csr_extra=csr_extra,
             )

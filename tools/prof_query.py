@@ -179,7 +179,11 @@ def main():
           f"lookup_bulk={ms_lookup:.2f} check_arrays={ms_arrays:.2f}",
           flush=True)
 
-    # ---- expand p50/p95 over the bench's root sample
+    # ---- expand p50/p95 over the bench's root sample (graph frozen out
+    # of the cyclic GC, as the serving registry does at boot)
+    import gc as _gc
+
+    _gc.freeze()
     from keto_tpu.engine.device import SnapshotExpandEngine
 
     expander = SnapshotExpandEngine(snapshots, max_depth=5)
