@@ -66,19 +66,21 @@ class _MuxedPort:
 
     def __init__(
         self, host: str, port: int, grpc_port: int, http_port: int,
-        ssl_context=None,
+        ssl_context=None, reuse_port: bool = False,
     ):
         self.host = host
         self.port = port
         self.grpc_port = grpc_port
         self.http_port = http_port
         self.ssl_context = ssl_context
+        self.reuse_port = reuse_port
         self._server: Optional[asyncio.base_events.Server] = None
         self._conns: set[asyncio.Task] = set()
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(
-            self._handle, self.host, self.port, ssl=self.ssl_context
+            self._handle, self.host, self.port, ssl=self.ssl_context,
+            reuse_port=self.reuse_port or None,
         )
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
@@ -213,6 +215,7 @@ class PlaneServer:
         self, grpc_server: grpc.Server, app: web.Application,
         host: str = "0.0.0.0", port: int = 0, ssl_context=None,
         expose_backends: bool = False,
+        grpc_port: int = 0, http_port: int = 0, reuse_port: bool = False,
     ):
         self.grpc_server = grpc_server
         self.app = app
@@ -220,8 +223,13 @@ class PlaneServer:
         self.port = port
         self.ssl_context = ssl_context
         self.expose_backends = expose_backends
-        self.grpc_port: int = 0
-        self.http_port: int = 0
+        # fixed backend ports + reuse_port: the read-replica pool
+        # (driver/replicas.py) runs one PlaneServer per worker PROCESS, all
+        # binding the same three ports via SO_REUSEPORT so the kernel
+        # load-balances accepts across workers
+        self.grpc_port: int = grpc_port
+        self.http_port: int = http_port
+        self.reuse_port = reuse_port
         self._runner: Optional[web.AppRunner] = None
         self._mux: Optional[_MuxedPort] = None
 
@@ -236,19 +244,26 @@ class PlaneServer:
             if (self.expose_backends and not self.ssl_context)
             else "127.0.0.1"
         )
+        # grpcio enables SO_REUSEPORT on server listeners by default on
+        # Linux, so a fixed port is all a replica needs to share it
         self.grpc_port = self.grpc_server.add_insecure_port(
-            f"{backend_host}:0"
+            f"{backend_host}:{self.grpc_port}"
         )
+        if self.grpc_port == 0:
+            raise OSError("gRPC backend port bind failed")
         self.grpc_server.start()
         # bounded graceful shutdown: don't wait out idle keep-alive clients
         self._runner = web.AppRunner(self.app, shutdown_timeout=2.0)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, backend_host, 0)
+        site = web.TCPSite(
+            self._runner, backend_host, self.http_port,
+            reuse_port=self.reuse_port or None,
+        )
         await site.start()
         self.http_port = site._server.sockets[0].getsockname()[1]
         self._mux = _MuxedPort(
             self.host, self.port, self.grpc_port, self.http_port,
-            ssl_context=self.ssl_context,
+            ssl_context=self.ssl_context, reuse_port=self.reuse_port,
         )
         self.port = await self._mux.start()
         return self.port

@@ -80,8 +80,13 @@ def cli(ctx, read_remote, write_remote):
     "--profile-out", default="keto_profile.out", show_default=True,
     help="where `profiling: cpu` writes its pstats dump on shutdown",
 )
+@click.option(
+    "--workers", default=0, show_default=True,
+    help="read-replica worker processes sharing the read port via "
+    "SO_REUSEPORT (0 = use serve.read.workers from the config)",
+)
 @click.pass_context
-def serve(ctx, config_file, profile_out):
+def serve(ctx, config_file, profile_out, workers):
     """Start the read (:4466) and write (:4467) servers
     (reference cmd/server/serve.go). With `profiling: cpu` in the config,
     the serve lifetime's MAIN THREAD (the asyncio event loop: REST
@@ -93,6 +98,8 @@ def serve(ctx, config_file, profile_out):
     from ..driver import Config, Registry
 
     config = Config(config_file=config_file)
+    if workers > 0:
+        config.set_override("serve.read.workers", workers)
     registry = Registry(config)
 
     async def _run():

@@ -76,6 +76,9 @@ _PORT_SCHEMA = {
         # opt-in: bind the plaintext gRPC/HTTP backend ports on the public
         # host (for protocol-aware LBs); default keeps them loopback-only
         "expose_backend_ports": {"type": "boolean"},
+        # read plane only: number of forked read-replica worker processes
+        # sharing the port via SO_REUSEPORT (driver/replicas.py)
+        "workers": {"type": "integer", "minimum": 1},
     },
     "additionalProperties": True,
 }
@@ -182,6 +185,7 @@ DEFAULTS = {
     "serve.read.port": 4466,
     "serve.read.host": "",
     "serve.read.max-depth": 5,
+    "serve.read.workers": 1,
     "serve.write.port": 4467,
     "serve.write.host": "",
     "log.level": "info",

@@ -206,6 +206,10 @@ class ClosureCheckEngine:
             raise ValueError(f"unknown freshness {freshness!r}")
         self.query_mode = query_mode
         self.freshness = freshness
+        # forked read replicas flip this off: jax is fork-unsafe, so a
+        # replica that outgrows its overlay serves from the live-store
+        # oracle (slow, exact) instead of attempting a device rebuild
+        self.allow_device_builds = True
         self.strong_freshness_edges = strong_freshness_edges
         self.rebuild_debounce_s = rebuild_debounce_s
         self._host_queries: Optional[bool] = (
@@ -272,29 +276,6 @@ class ClosureCheckEngine:
             ov.enqueue(version, inserted, deleted)
         with self._state_cv:
             self._state_cv.notify_all()  # freshness waiters re-check
-
-    def _pin_overlay(self, state) -> Optional[WriteOverlay]:
-        """Pin the overlay for one batch. The SAME object must serve the
-        whole batch: re-resolving self._overlay mid-batch could swap in a
-        new generation (compaction rebuild) and silently drop the
-        corrections _serving promised. A pinned overlay stays usable even
-        if a later delta breaks it — the two-phase apply keeps a broken
-        overlay consistent at its last covered version."""
-        if not isinstance(state, _ClosureArtifacts):
-            return None
-        ov = self._overlay
-        if ov is None or ov.art is not state:
-            return None
-        ov.drain()
-        if ov.n_events == 0:
-            return None
-        if ov.broken:
-            self._kick_rebuild()
-        elif ov.n_events > ov.max_events // 2:
-            # proactive compaction: fold a large overlay back into a fresh
-            # closure in the background while the overlay keeps serving
-            self._kick_rebuild()
-        return ov
 
     # -- residency ------------------------------------------------------------
 
@@ -364,27 +345,51 @@ class ClosureCheckEngine:
         return state.num_edges >= self.strong_freshness_edges
 
     def _serving(self) -> _State:
-        """The state answering this check — fresh, overlay-corrected (exact
-        at the live version, no rebuild), or stale-with-rebuild under
-        bounded freshness. Never stalls on a rebuild once a state exists
-        and the policy is bounded."""
-        state = self._state
-        store_version = self.snapshots.store.version
-        if state is not None and state.version == store_version:
-            return state
-        if isinstance(state, _ClosureArtifacts):
+        """Compatibility wrapper over _serving_pinned (callers that don't
+        need overlay corrections, e.g. version accessors)."""
+        return self._serving_pinned()[0]
+
+    def _serving_pinned(
+        self,
+    ) -> tuple[_State, Optional[WriteOverlay]]:
+        """The (state, pinned overlay) pair answering this batch — fresh,
+        overlay-corrected (exact at the live version, no rebuild), or
+        stale-with-rebuild under bounded freshness. Never stalls on a
+        rebuild once a state exists and the policy is bounded.
+
+        state and overlay are read together and returned as HELD
+        references: deciding on one overlay and then re-reading
+        self._overlay later would race the compaction rebuild's generation
+        swap and silently drop the corrections this method promised."""
+        while True:
+            state = self._state
             ov = self._overlay
-            if ov is not None and ov.art is state:
+            if not (
+                isinstance(state, _ClosureArtifacts)
+                and ov is not None
+                and ov.art is state
+            ):
+                ov = None
+            if ov is not None:
                 ov.drain()
-                if ov.active(self.snapshots.store.version):
-                    # every write since the snapshot is absorbed: serve the
-                    # resident closure + overlay corrections — exact at the
-                    # live version under ANY freshness policy
-                    return state
-        if self._bounded(state):
-            self._kick_rebuild()
-            return state
-        return self._build_sync()
+            store_version = self.snapshots.store.version
+            pinned = ov if (ov is not None and ov.n_events) else None
+            if state is not None and state.version == store_version:
+                return state, pinned
+            if ov is not None and ov.active(store_version):
+                # every write since the snapshot is absorbed: serve the
+                # resident closure + overlay corrections — exact at the
+                # live version under ANY freshness policy
+                if ov.n_events > ov.max_events // 2:
+                    # proactive compaction: fold a large overlay back into
+                    # a fresh closure in the background while it serves
+                    self._kick_rebuild()
+                return state, pinned
+            if self._bounded(state):
+                self._kick_rebuild()
+                return state, pinned
+            self._build_sync()
+            # loop: re-read state AND overlay together for the fresh pin
 
     def _build_sync(self) -> _State:
         with self._build_lock:
@@ -447,6 +452,13 @@ class ClosureCheckEngine:
             with self.tracer.span("closure.interior"):
                 ig = build_interior(snap)
             span.set_attr("interior", ig.m)
+            if not self.allow_device_builds:
+                # forked replica past its overlay: no device access, no
+                # rebuild — exact answers from the live store instead
+                span.set_attr("kind", "replica-fallback")
+                return _TooBig(
+                    version=snap.version, num_edges=snap.num_edges
+                )
             if ig.m > self.interior_limit or (
                 self.global_max_depth > _MAX_CLOSURE_DEPTH
             ):
@@ -614,7 +626,7 @@ class ClosureCheckEngine:
         if not requests:
             return []
         t0 = time.perf_counter()
-        state = self._serving()
+        state, pinned = self._serving_pinned()
         if not isinstance(state, _ClosureArtifacts):
             # interior too large for a closure: exact fallback
             return self.fallback_engine().batch_check(
@@ -672,8 +684,7 @@ class ClosureCheckEngine:
         )
 
         allowed = self._check_arrays(
-            snap, art, s_ids, t_ids, is_id, depth,
-            self._pin_overlay(art), requests
+            snap, art, s_ids, t_ids, is_id, depth, pinned, requests
         )
         if self._m_checks is not None:
             self._m_checks.inc(n)
@@ -705,7 +716,7 @@ class ClosureCheckEngine:
             depth = np.where((want <= 0) | (want > gmax), gmax, want).astype(
                 np.int32
             )
-        state = self._serving()
+        state, pinned = self._serving_pinned()
         if not isinstance(state, _ClosureArtifacts):
             snap = self.snapshots.snapshot()
             reqs = self._decode_requests(snap, start, target)
@@ -724,7 +735,7 @@ class ClosureCheckEngine:
         art = state
         snap = art.snap
         return self._check_arrays(
-            snap, art, start, target, is_id, depth, self._pin_overlay(art)
+            snap, art, start, target, is_id, depth, pinned
         )
 
     def _decode_requests(self, snap, start, target) -> list[RelationTuple]:

@@ -196,6 +196,19 @@ class WriteOverlay:
             )
         )
 
+    def _base_out_neighbors(self, nid: int) -> np.ndarray:
+        """One node's base successors in insertion order. Uses the
+        snapshot's CSR only when it is ALREADY derived: promotion runs
+        inside the locked drain, and forcing the full O(E log E) CSR sort
+        there (e.g. right after a delete-rebuild dropped it) would stall
+        every query thread behind one routine write — an O(E) masked scan
+        of the COO arrays is bounded and lock-friendly."""
+        snap = self.art.snap
+        if snap._csr is not None:
+            return snap.out_neighbors(nid)
+        e = snap.num_edges
+        return snap.dst[:e][snap.src[:e] == nid]
+
     def _grow_interior(self, nid: int) -> int:
         """Allocate a D index for a newly-interior set node from the INF
         padding (diag zeroed so self-paths cost 0). -1 when out of room
@@ -221,7 +234,7 @@ class WriteOverlay:
         is_set = art.snap.vocab.is_set_array()
         # (a) BASE out-edges, minus any the overlay already deleted
         if nid < ig.padded_nodes:
-            succ = art.snap.out_neighbors(nid)
+            succ = self._base_out_neighbors(nid)
             if succ.size:
                 self.n_events += int(succ.size)
                 for v in succ.tolist():
@@ -309,7 +322,7 @@ class WriteOverlay:
                 hypo_interior.add(d)
                 # promotion reclassifies existing set successors into D
                 if d < ig.padded_nodes:
-                    succ = self.art.snap.out_neighbors(d)
+                    succ = self._base_out_neighbors(d)
                     if succ.size:
                         n_events += int(succ.size)
                         sets = succ[is_set_arr[succ]]
