@@ -839,9 +839,14 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
     loop.call_soon_threadsafe(loop.stop)
     loop_thread.join(timeout=10)
 
+    pool = reg._replica_pool
+    effective_workers = 1 + (len(pool._children) if pool is not None else 0)
     out = {
         "config": f"{name}_server",
-        "server_workers": n_workers,
+        # EFFECTIVE count: the registry demotes to single-process when the
+        # engine/store cannot be fork-shared — reporting the requested
+        # count would misattribute single-process numbers to a pool
+        "server_workers": effective_workers,
         # cold = unique requests (no result-cache reuse); hot cycles a
         # 4096-request pool where post-first-cycle singles are cache hits
         # (the realistic hot-set case). Reported separately per VERDICT r3.
@@ -931,6 +936,70 @@ def _sharded_child():
                     "tuples": len(store),
                     "batch": batch,
                     "check_rps_encoded": round(rps),
+                }
+            ),
+            flush=True,
+        )
+
+    # the 1B-rung engine: D replicated, boundary CSRs node-striped over
+    # 'edge', two pmin collectives per batch. A scaled-down model of the
+    # BASELINE v5e-16 configuration: per-shard residency bytes are logged
+    # so the 1B projection is arithmetic, not faith.
+    from keto_tpu.parallel import ShardedClosureEngine
+
+    # 200k keeps the interior ~2.2k so the O(M^3) closure build stays
+    # CPU-feasible on the virtual mesh; raise on real TPU hardware
+    n_cls = int(os.environ.get("BENCH_SHARDED_CLOSURE_TUPLES", 200_000))
+    store2, sample2, _roots2 = gen_rbac(n_cls, np.random.default_rng(7))
+    snapshots2 = SnapshotManager(store2)
+    snap2 = snapshots2.snapshot()
+    lookup2 = snap2.vocab.lookup
+    dummy2 = snap2.dummy_node
+    cls_batches = []
+    for _ in range(iters):
+        skeys, dkeys = sample2(rng, batch)
+        s = np.array(
+            [v if (v := lookup2(k)) is not None else dummy2 for k in skeys],
+            np.int64,
+        )
+        d = np.array(
+            [v if (v := lookup2(k)) is not None else dummy2 for k in dkeys],
+            np.int64,
+        )
+        is_id = np.fromiter((len(k) == 1 for k in dkeys), bool, count=batch)
+        cls_batches.append((s, d, is_id))
+    for data, edge in ((1, 8), (2, 4)):
+        mesh = make_mesh(jax.devices()[:8], data=data, edge=edge)
+        engine = ShardedClosureEngine(snapshots2, mesh=mesh, max_depth=5)
+        engine.check_ids(*cls_batches[0])  # closure build + compile
+        t0 = time.time()
+        for s, d, flag in cls_batches:
+            engine.check_ids(s, d, flag)
+        rps = batch * iters / (time.time() - t0)
+        per_shard = engine.shard_bytes()
+        edges_per_shard = snap2.num_edges / edge
+        print(
+            json.dumps(
+                {
+                    "config": "sharded_closure_cpu8",
+                    "mesh": f"{data}x{edge}",
+                    "tuples": len(store2),
+                    "batch": batch,
+                    "check_rps_encoded": round(rps),
+                    "per_shard_bytes": per_shard,
+                    # straight-line projection of the striped classes to
+                    # the 1B rung (D stays fixed — interior doesn't scale
+                    # with users/objects)
+                    "projected_1b_per_shard_gb": round(
+                        (
+                            per_shard["total_per_shard"]
+                            - per_shard["d_replicated"]
+                        )
+                        * (1_000_000_000 / 16 / edges_per_shard)
+                        / 1e9
+                        + per_shard["d_replicated"] / 1e9,
+                        2,
+                    ),
                 }
             ),
             flush=True,

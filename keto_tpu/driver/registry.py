@@ -484,6 +484,18 @@ class Registry:
                 engine=type(engine).__name__,
             )
             n_workers = 1
+        if n_workers > 1 and not getattr(
+            self.store(), "process_private", False
+        ):
+            # a SQL-backed store shares one database: forked replicas
+            # re-applying deltas over fork-inherited connections would
+            # double-commit every write
+            log.warn(
+                "read workers require a process-private store "
+                "(memory/columnar); serving single-process",
+                store=type(self.store()).__name__,
+            )
+            n_workers = 1
         if n_workers > 1:
             # fork read replicas BEFORE this process creates any gRPC
             # server or binds ports (grpc's C core is not fork-safe once

@@ -242,11 +242,20 @@ class ReplicaPool:
         asyncio.set_event_loop(loop)
 
         async def boot():
-            plane = reg.build_read_plane_shared(
-                read_port, grpc_port, http_port
-            )
-            await plane.start()
-            reg.health.set_serving(True)
+            try:
+                plane = reg.build_read_plane_shared(
+                    read_port, grpc_port, http_port
+                )
+                await plane.start()
+                reg.health.set_serving(True)
+            except BaseException:
+                # a replica that cannot serve must DIE, not linger as a
+                # delta-draining zombie the parent counts as capacity
+                # (port stolen in the resolve-to-bind window, etc.)
+                import traceback
+
+                traceback.print_exc()
+                os._exit(4)
 
         loop.create_task(boot())
         loop.run_forever()

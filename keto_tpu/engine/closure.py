@@ -449,16 +449,18 @@ class ClosureCheckEngine:
         with self.tracer.span(
             "closure.build", edges=snap.num_edges, version=snap.version
         ) as span:
-            with self.tracer.span("closure.interior"):
-                ig = build_interior(snap)
-            span.set_attr("interior", ig.m)
             if not self.allow_device_builds:
                 # forked replica past its overlay: no device access, no
-                # rebuild — exact answers from the live store instead
+                # rebuild — exact answers from the live store instead.
+                # Checked BEFORE build_interior: the O(E) interior scan
+                # would be discarded, and rebuild kicks recur per write.
                 span.set_attr("kind", "replica-fallback")
                 return _TooBig(
                     version=snap.version, num_edges=snap.num_edges
                 )
+            with self.tracer.span("closure.interior"):
+                ig = build_interior(snap)
+            span.set_attr("interior", ig.m)
             if ig.m > self.interior_limit or (
                 self.global_max_depth > _MAX_CLOSURE_DEPTH
             ):

@@ -8,6 +8,12 @@ per expansion step, and keep the whole depth loop inside one compiled
 program — no host round-trips between steps.
 """
 
+from .closure_sharded import ShardedClosureEngine
 from .sharded import ShardedCheckEngine, make_mesh, sharded_check
 
-__all__ = ["ShardedCheckEngine", "make_mesh", "sharded_check"]
+__all__ = [
+    "ShardedCheckEngine",
+    "ShardedClosureEngine",
+    "make_mesh",
+    "sharded_check",
+]

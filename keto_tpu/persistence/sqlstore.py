@@ -71,6 +71,10 @@ def _subject_columns(t: RelationTuple):
 
 
 class SQLTupleStore(Manager):
+    # NOT fork-shareable: replicas re-applying deltas over fork-inherited
+    # connections would double-commit against the shared database
+    process_private = False
+
     def __init__(
         self,
         dialect: SQLDialect,

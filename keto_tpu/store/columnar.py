@@ -77,6 +77,10 @@ class _StringPool:
 
 
 class ColumnarTupleStore(Manager):
+    # replica pools may fork this store: its state is process-private
+    # (driver/replicas.py gates on this)
+    process_private = True
+
     def __init__(
         self,
         namespace_manager: NamespaceManager | None = None,

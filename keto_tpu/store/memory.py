@@ -35,6 +35,10 @@ from ..utils.pagination import (
 
 
 class InMemoryTupleStore(Manager):
+    # replica pools may fork this store: its state is process-private
+    # (driver/replicas.py gates on this)
+    process_private = True
+
     """Insertion-ordered, deduplicated, thread-safe tuple store.
 
     Writing an already-existing tuple is a no-op for reads (the reference's

@@ -38,7 +38,7 @@ def pool_server():
     loop.call_soon_threadsafe(loop.stop)
 
 
-def _converges(rp, params, want_status, tries=24, timeout=45.0):
+def _converges(rp, params, want_status, tries=24, timeout=120.0):
     """Fresh connection per probe: SO_REUSEPORT spreads them over the
     replicas, so `tries` consecutive agreements cover the whole pool."""
     deadline = time.time() + timeout
