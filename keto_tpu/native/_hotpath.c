@@ -277,8 +277,11 @@ static PyObject *request_hashes(PyObject *self, PyObject *args) {
         PyObject *r = items[i];
         PyTypeObject *tp = Py_TYPE(r);
         if (rt_cache.type == NULL && tp != rt_failed) {
-            /* discover RelationTuple's slot layout from the first item */
-            SlotCache c;
+            /* discover RelationTuple's slot layout from the first item.
+             * Zero-init: `rt_cache = c` copies the whole struct, and
+             * cache_type() then Py_XDECREFs rt_cache.type — an
+             * uninitialized c.type would be garbage freed. */
+            SlotCache c = {0};
             c.off_ns = member_offset(tp, s_namespace);
             c.off_obj = member_offset(tp, s_object);
             c.off_rel = member_offset(tp, s_relation);
@@ -330,7 +333,9 @@ static PyObject *request_hashes(PyObject *self, PyObject *args) {
             } else {
                 if (sset_cache.type != stp) {
                     if (stp == sset_failed) goto slow;
-                    SlotCache c;
+                    /* zero-init: same Py_XDECREF-of-garbage hazard as the
+                     * RelationTuple discovery block above */
+                    SlotCache c = {0};
                     c.off_sns = member_offset(stp, s_namespace);
                     c.off_sobj = member_offset(stp, s_object);
                     c.off_srel = member_offset(stp, s_relation);

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import threading
 import uuid
-from typing import Callable, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -40,6 +40,7 @@ from ..relationtuple.definitions import (
     SubjectSet,
 )
 from ..utils.errors import ErrInvalidTuple
+from .notify import OrderedNotifier
 from ..utils.pagination import (
     PaginationOptions,
     decode_page_token,
@@ -76,7 +77,7 @@ class _StringPool:
         return self._strings[i]
 
 
-class ColumnarTupleStore(Manager):
+class ColumnarTupleStore(OrderedNotifier, Manager):
     # replica pools may fork this store: its state is process-private
     # (driver/replicas.py gates on this)
     process_private = True
@@ -135,10 +136,7 @@ class ColumnarTupleStore(Manager):
         self._node_sid = np.empty(0, np.int32)
         self._derived_len = 0  # rows [0, _derived_len) have string columns
         self._version = 0
-        self._listeners: list[Callable[[int], None]] = []
-        self._delta_listeners: list[
-            Callable[[int, list[RelationTuple], list[RelationTuple]], None]
-        ] = []
+        self._init_notify()
 
     # -- version / change feed ------------------------------------------------
 
@@ -147,23 +145,9 @@ class ColumnarTupleStore(Manager):
         with self._lock:
             return self._version
 
-    def subscribe(self, fn: Callable[[int], None]) -> None:
-        self._listeners.append(fn)
-
-    def subscribe_deltas(self, fn) -> None:
-        self._delta_listeners.append(fn)
-
-    def unsubscribe_deltas(self, fn) -> None:
-        try:
-            self._delta_listeners.remove(fn)
-        except ValueError:
-            pass
-
-    def _notify(self, version, inserted=None, deleted=None) -> None:
-        for fn in self._listeners:
-            fn(version)
-        for fn in self._delta_listeners:
-            fn(version, inserted or [], deleted or [])
+    # subscribe/subscribe_deltas/unsubscribe_deltas come from
+    # OrderedNotifier: deltas enqueue under the write lock, deliver in
+    # strict version order.
 
     # -- internals ------------------------------------------------------------
 
@@ -405,7 +389,8 @@ class ColumnarTupleStore(Manager):
             ]
             self._version += 1
             v = self._version
-        self._notify(v, inserted=fresh)
+            self._enqueue_notification(v, inserted=fresh)
+        self._drain_notifications(upto=v)
 
     def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
         with self._lock:
@@ -414,7 +399,8 @@ class ColumnarTupleStore(Manager):
             ]
             self._version += 1
             v = self._version
-        self._notify(v, deleted=gone)
+            self._enqueue_notification(v, deleted=gone)
+        self._drain_notifications(upto=v)
 
     def delete_all_relation_tuples(self, query: RelationQuery) -> None:
         with self._lock:
@@ -428,7 +414,8 @@ class ColumnarTupleStore(Manager):
                 self._row_of.pop(key, None)  # chunks tombstone via `alive`
             self._version += 1
             v = self._version
-        self._notify(v, deleted=gone)
+            self._enqueue_notification(v, deleted=gone)
+        self._drain_notifications(upto=v)
 
     def transact_relation_tuples(
         self,
@@ -446,7 +433,8 @@ class ColumnarTupleStore(Manager):
             ]
             self._version += 1
             v = self._version
-        self._notify(v, inserted=fresh, deleted=gone)
+            self._enqueue_notification(v, inserted=fresh, deleted=gone)
+        self._drain_notifications(upto=v)
 
     # -- bulk + snapshot support ----------------------------------------------
 

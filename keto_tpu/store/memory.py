@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 import uuid
-from typing import Callable, Sequence
+from typing import Sequence
 
 from ..namespace.definitions import NamespaceManager
 from ..relationtuple.definitions import (
@@ -27,6 +27,7 @@ from ..relationtuple.definitions import (
     RelationTuple,
 )
 from ..utils.errors import ErrInvalidTuple
+from .notify import OrderedNotifier
 from ..utils.pagination import (
     PaginationOptions,
     decode_page_token,
@@ -34,11 +35,7 @@ from ..utils.pagination import (
 )
 
 
-class InMemoryTupleStore(Manager):
-    # replica pools may fork this store: its state is process-private
-    # (driver/replicas.py gates on this)
-    process_private = True
-
+class InMemoryTupleStore(OrderedNotifier, Manager):
     """Insertion-ordered, deduplicated, thread-safe tuple store.
 
     Writing an already-existing tuple is a no-op for reads (the reference's
@@ -46,6 +43,10 @@ class InMemoryTupleStore(Manager):
     dialects; its contract tests never insert duplicates — we keep idempotent
     upsert semantics, which Zanzibar specifies).
     """
+
+    # replica pools may fork this store: its state is process-private
+    # (driver/replicas.py gates on this)
+    process_private = True
 
     def __init__(
         self,
@@ -59,12 +60,12 @@ class InMemoryTupleStore(Manager):
         self._version = 0
         self.namespace_manager = namespace_manager
         self.network_id = network_id or str(uuid.uuid4())
-        self._listeners: list[Callable[[int], None]] = []
-        self._delta_listeners: list[
-            Callable[[int, list[RelationTuple], list[RelationTuple]], None]
-        ] = []
+        self._init_notify()
 
     # -- version / change feed ------------------------------------------------
+    # (subscribe/subscribe_deltas/unsubscribe_deltas come from
+    # OrderedNotifier: deltas are enqueued under the write lock and
+    # delivered in strict version order)
 
     @property
     def version(self) -> int:
@@ -72,39 +73,9 @@ class InMemoryTupleStore(Manager):
         with self._lock:
             return self._version
 
-    def subscribe(self, fn: Callable[[int], None]) -> None:
-        """Register a callback invoked (under no lock) after each mutation."""
-        self._listeners.append(fn)
-
-    def subscribe_deltas(
-        self,
-        fn: Callable[[int, list[RelationTuple], list[RelationTuple]], None],
-    ) -> None:
-        """Register ``fn(version, inserted, deleted)`` — the write-plane feed
-        the device snapshot layer consumes for incremental refresh
-        (SURVEY.md §2.10 read/write plane split)."""
-        self._delta_listeners.append(fn)
-
-    def unsubscribe_deltas(self, fn) -> None:
-        try:
-            self._delta_listeners.remove(fn)
-        except ValueError:
-            pass
-
     def _bump(self) -> int:
         self._version += 1
         return self._version
-
-    def _notify(
-        self,
-        version: int,
-        inserted: list[RelationTuple] | None = None,
-        deleted: list[RelationTuple] | None = None,
-    ) -> None:
-        for fn in self._listeners:
-            fn(version)
-        for fn in self._delta_listeners:
-            fn(version, inserted or [], deleted or [])
 
     # -- validation -----------------------------------------------------------
 
@@ -150,7 +121,8 @@ class InMemoryTupleStore(Manager):
                     self._seq += 1
                     fresh.append(t)
             v = self._bump()
-        self._notify(v, inserted=fresh)
+            self._enqueue_notification(v, inserted=fresh)
+        self._drain_notifications(upto=v)
 
     def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
         with self._lock:
@@ -159,7 +131,8 @@ class InMemoryTupleStore(Manager):
                 if self._tuples.pop(t, None) is not None:
                     gone.append(t)
             v = self._bump()
-        self._notify(v, deleted=gone)
+            self._enqueue_notification(v, deleted=gone)
+        self._drain_notifications(upto=v)
 
     def delete_all_relation_tuples(self, query: RelationQuery) -> None:
         with self._lock:
@@ -167,7 +140,8 @@ class InMemoryTupleStore(Manager):
             for t in gone:
                 del self._tuples[t]
             v = self._bump()
-        self._notify(v, deleted=gone)
+            self._enqueue_notification(v, deleted=gone)
+        self._drain_notifications(upto=v)
 
     def transact_relation_tuples(
         self,
@@ -191,7 +165,8 @@ class InMemoryTupleStore(Manager):
                 if self._tuples.pop(t, None) is not None:
                     gone.append(t)
             v = self._bump()
-        self._notify(v, inserted=fresh, deleted=gone)
+            self._enqueue_notification(v, inserted=fresh, deleted=gone)
+        self._drain_notifications(upto=v)
 
     # -- snapshot support -----------------------------------------------------
 

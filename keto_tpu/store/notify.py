@@ -1,0 +1,162 @@
+"""Version-ordered store notification delivery.
+
+Every store mutator used to call its listeners *after* releasing the store
+write lock, so two writes racing on the 32-thread gRPC write pool could
+deliver their deltas out of version order. Downstream consumers key hard on
+ordering: each forked read replica applies delta frames contiguously
+(driver/replicas.py) and the serving-time write overlay treats a version gap
+as corruption and forces a full closure rebuild (engine/overlay.py). One
+inverted pair silently collapsed the replica pool to a single process under
+ordinary concurrent write load (ADVICE r4, severity medium).
+
+The fix is structural, not a sleep: mutators *enqueue* ``(version, inserted,
+deleted)`` while still holding the store write lock — queue order therefore
+equals version-assignment order — and *drain* after releasing it. A
+dedicated delivery lock serializes drains, so listeners always observe
+strictly increasing versions.
+
+Two contract guarantees beyond ordering:
+
+- **Read-your-notification:** a mutator does not return until its own
+  delta has been delivered (the old lock-free code ran listeners on the
+  writer's thread synchronously; code that writes then immediately expects
+  a replica/overlay to have observed the delta relies on this). Drain
+  therefore takes the caller's version and waits on a condition until
+  delivery passes it, even when a concurrent drainer delivers the entry.
+- **Listener re-entrancy:** listeners run outside the store lock and may
+  call back into the store, including mutating it. A mutation from inside
+  a listener re-enters drain on the delivering thread; an owner check
+  turns that inner drain into a no-op (the outer drain loop delivers the
+  new entry next iteration) instead of self-deadlocking on the
+  non-reentrant delivery lock.
+
+Listener exceptions are logged and swallowed: under ordered delivery a
+drainer frequently delivers OTHER writers' versions, so propagating would
+blame a committed write on an innocent caller and strand every queued
+notification behind the failure. (The old lock-free code raised into the
+writer — possible only because it also allowed out-of-order delivery.)
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+from ..relationtuple.definitions import RelationTuple
+
+DeltaListener = Callable[[int, list[RelationTuple], list[RelationTuple]], None]
+
+
+def _log_listener_failure(version: int) -> None:
+    # a sick listener must not fail an innocent writer's call (the
+    # delivering thread is frequently not the version's writer) or strand
+    # queued versions behind the failure
+    import logging
+
+    logging.getLogger("keto.store").exception(
+        "store notification listener failed (version %d)", version
+    )
+
+
+class OrderedNotifier:
+    """Mixin: version-ordered ``subscribe``/``subscribe_deltas`` delivery.
+
+    Usage contract for the host store:
+    - call ``_init_notify()`` in ``__init__``,
+    - call ``_enqueue_notification(version, ...)`` while HOLDING the store
+      write lock (right after assigning ``version``) — for transactional
+      stores, only after the transaction has COMMITTED (a rolled-back
+      write must never surface a phantom delta),
+    - call ``_drain_notifications(upto=version)`` after RELEASING it.
+    """
+
+    def _init_notify(self) -> None:
+        self._listeners: list[Callable[[int], None]] = []
+        self._delta_listeners: list[DeltaListener] = []
+        self._pending_notifications: deque = deque()
+        self._deliver_lock = threading.Lock()
+        self._deliver_cv = threading.Condition()
+        self._deliver_owner: Optional[int] = None
+        self._delivered_upto = 0
+
+    # -- subscription surface (unchanged from the per-store originals) --------
+
+    def subscribe(self, fn: Callable[[int], None]) -> None:
+        """Register a callback invoked (outside the store lock, in version
+        order) after each mutation."""
+        self._listeners.append(fn)
+
+    def subscribe_deltas(self, fn: DeltaListener) -> None:
+        """Register ``fn(version, inserted, deleted)`` — the write-plane feed
+        the device snapshot layer consumes for incremental refresh
+        (SURVEY.md §2.10 read/write plane split). Delivery is strictly
+        version-ordered."""
+        self._delta_listeners.append(fn)
+
+    def unsubscribe_deltas(self, fn) -> None:
+        try:
+            self._delta_listeners.remove(fn)
+        except ValueError:
+            pass
+
+    # -- ordered delivery ------------------------------------------------------
+
+    def _enqueue_notification(
+        self,
+        version: int,
+        inserted: list[RelationTuple] | None = None,
+        deleted: list[RelationTuple] | None = None,
+    ) -> None:
+        """MUST be called while holding the store write lock (and, for
+        transactional stores, after commit): the append order of this
+        deque is the delivery order."""
+        self._pending_notifications.append(
+            (version, inserted or [], deleted or [])
+        )
+
+    def _drain_notifications(self, upto: Optional[int] = None) -> None:
+        """Deliver pending notifications in enqueue (= version) order, then
+        — when ``upto`` is given — wait until delivery has passed that
+        version even if a concurrent drainer took the entry. Safe to call
+        from any thread after releasing the store lock."""
+        me = threading.get_ident()
+        if self._deliver_owner == me:
+            # re-entrant call from inside a listener that mutated the
+            # store: the outer drain loop delivers the new entry next
+            # iteration; blocking here would self-deadlock
+            return
+        while self._pending_notifications:
+            with self._deliver_lock:
+                try:
+                    version, inserted, deleted = (
+                        self._pending_notifications.popleft()
+                    )
+                except IndexError:
+                    break  # a concurrent drainer took the remaining entries
+                self._deliver_owner = me
+                try:
+                    # snapshot the lists: a listener may unsubscribe
+                    # (itself or another) mid-delivery, and an in-place
+                    # shift would silently skip the next listener for
+                    # this version
+                    for fn in list(self._listeners):
+                        try:
+                            fn(version)
+                        except Exception:
+                            _log_listener_failure(version)
+                    for dfn in list(self._delta_listeners):
+                        try:
+                            dfn(version, inserted, deleted)
+                        except Exception:
+                            _log_listener_failure(version)
+                finally:
+                    self._deliver_owner = None
+                    with self._deliver_cv:
+                        if version > self._delivered_upto:
+                            self._delivered_upto = version
+                        self._deliver_cv.notify_all()
+        if upto is not None:
+            with self._deliver_cv:
+                while self._delivered_upto < upto:
+                    self._deliver_cv.wait(timeout=1.0)
