@@ -63,6 +63,12 @@ class SQLDialect:
             "keto_store_version.version + 1 RETURNING version"
         )
 
+    def bump_version(self, exec_fn, nid: str) -> int:
+        """Run the version bump through the store's executor and return
+        the new value. Engines without upsert-RETURNING (mysql) override
+        this whole hook instead of the SQL string."""
+        return int(exec_fn(self.bump_version_sql(), (nid,)).fetchone()[0])
+
     def migration_files(self, directory: str) -> dict[str, str]:
         """filename -> path, with <ver>_<name>.<dialect>.{up,down}.sql
         overlays replacing the generic file of the same version/direction."""
@@ -106,13 +112,13 @@ class SQLiteDialect(SQLDialect):
 
 
 class PostgresDialect(SQLDialect):
-    """Complete adapter; connects only where a psycopg driver exists.
+    """Postgres adapter. Driver resolution order: psycopg (3), psycopg2,
+    then the in-tree pure-Python wire driver (`pgwire.py`) — so the
+    dialect connects in every environment, including the bare runtime
+    image, against any server speaking the v3 protocol (a real postgres,
+    CockroachDB, or the CI fake `pgfake.py`).
 
-    DSN form: any libpq connstring / URL accepted by psycopg. The runtime
-    image ships no postgres driver, so `connect` raises a clear error here
-    and the contract suite marks its postgres leg skipped (the same
-    graceful degradation the reference gets from `-short` skipping its
-    dockertest engines, internal/x/dbx/dsn_testutils.go:36-43).
+    DSN form: postgres:// URL.
     """
 
     name = "postgres"
@@ -128,22 +134,108 @@ class PostgresDialect(SQLDialect):
                 import psycopg2
 
                 conn = psycopg2.connect(dsn)
-            except ImportError as e:
-                raise RuntimeError(
-                    "no postgres driver available (psycopg/psycopg2 not in "
-                    "the runtime image); use the sqlite backend or install "
-                    "a driver"
-                ) from e
+            except ImportError:
+                from . import pgwire
+
+                conn = pgwire.connect(dsn)
         self.on_connect(conn)
         return conn
 
 
-DIALECTS = {d.name: d for d in (SQLiteDialect(), PostgresDialect())}
+class CockroachDialect(PostgresDialect):
+    """CockroachDB speaks the postgres wire protocol and (for this store's
+    SQL surface) the postgres dialect; what differs is the migration
+    overlay set (reference ships *.cockroach.up.sql files — e.g. UNIQUE
+    constraints instead of expression indexes) and the DSN scheme
+    (reference internal/x/dbx/dsn_testutils.go:54-61)."""
+
+    name = "cockroach"
+
+
+class MySQLDialect(SQLDialect):
+    """MySQL adapter: %s placeholders, INSERT IGNORE, a two-statement
+    version bump (MySQL has no RETURNING; ON DUPLICATE KEY UPDATE + read
+    back under the store's write lock is equivalent), and the *.mysql.*
+    migration overlays (reference persister.go:50-51 serves mysql through
+    pop the same way).
+
+    Driver resolution: pymysql, MySQLdb; without either, the in-tree
+    DB-API translation shim (`mysqlfake.py`) serves DSNs flagged
+    ``mysql+fake://`` so CI exercises this dialect's SQL end-to-end.
+    """
+
+    name = "mysql"
+    paramstyle = "format"
+
+    def insert_ignore(self, table: str, columns: Iterable[str]) -> str:
+        cols = list(columns)
+        ph = ", ".join("?" * len(cols))
+        return (
+            f"INSERT IGNORE INTO {table} "
+            f"({', '.join(cols)}) VALUES ({ph})"
+        )
+
+    def bump_version_sql(self) -> str:  # pragma: no cover - guarded below
+        raise NotImplementedError("mysql uses bump_version()")
+
+    def bump_version(self, exec_fn, nid: str) -> int:
+        exec_fn(
+            "INSERT INTO keto_store_version (nid, version) VALUES (?, 1) "
+            "ON DUPLICATE KEY UPDATE version = version + 1",
+            (nid,),
+        )
+        row = exec_fn(
+            "SELECT version FROM keto_store_version WHERE nid = ?", (nid,)
+        ).fetchone()
+        return int(row[0])
+
+    def connect(self, dsn: str):
+        if dsn.startswith("mysql+fake://"):
+            from . import mysqlfake
+
+            conn = mysqlfake.connect(dsn)
+            self.on_connect(conn)
+            return conn
+        try:
+            import pymysql as driver
+        except ImportError:
+            try:
+                import MySQLdb as driver
+            except ImportError as e:
+                raise RuntimeError(
+                    "no mysql driver available (pymysql/MySQLdb not in the "
+                    "runtime image); use a mysql+fake:// DSN for CI or "
+                    "install a driver"
+                ) from e
+        from urllib.parse import unquote, urlparse
+
+        u = urlparse(dsn)
+        conn = driver.connect(
+            host=u.hostname or "127.0.0.1",
+            port=u.port or 3306,
+            user=unquote(u.username or "root"),
+            password=unquote(u.password or ""),
+            database=(u.path or "/").lstrip("/"),
+        )
+        self.on_connect(conn)
+        return conn
+
+
+DIALECTS = {
+    d.name: d
+    for d in (
+        SQLiteDialect(),
+        PostgresDialect(),
+        CockroachDialect(),
+        MySQLDialect(),
+    )
+}
 
 
 def dialect_for_dsn(dsn: str) -> tuple[SQLDialect, str]:
     """(dialect, engine-native dsn) from a keto-style DSN. Mirrors the
-    reference's scheme dispatch (sqlite://, postgres://, ...)."""
+    reference's scheme dispatch (sqlite://, postgres://, mysql://,
+    cockroach://, internal/x/dbx/dsn.go)."""
     if not dsn or dsn == "memory" or dsn.startswith("sqlite://"):
         path = dsn[len("sqlite://") :] if dsn.startswith("sqlite://") else ""
         if path in ("", ":memory:", "/:memory:"):
@@ -151,4 +243,8 @@ def dialect_for_dsn(dsn: str) -> tuple[SQLDialect, str]:
         return DIALECTS["sqlite"], path
     if dsn.startswith(("postgres://", "postgresql://")):
         return DIALECTS["postgres"], dsn
+    if dsn.startswith("cockroach://"):
+        return DIALECTS["cockroach"], "postgres://" + dsn[len("cockroach://"):]
+    if dsn.startswith(("mysql://", "mysql+fake://")):
+        return DIALECTS["mysql"], dsn
     raise ValueError(f"unsupported DSN scheme: {dsn!r}")

@@ -131,6 +131,13 @@ class Migrator:
 
     def applied_versions(self) -> set[str]:
         rows = self._exec(f"SELECT version FROM {self.TABLE}").fetchall()
+        if not isinstance(self.conn, sqlite3.Connection):
+            # generic DB-API drivers open a transaction on ANY statement,
+            # SELECTs included; release the read snapshot or the
+            # open-transaction guard in _run_in_transaction trips on the
+            # migrator's own bookkeeping read (latent against psycopg2
+            # too — first exercised by the in-tree wire driver)
+            self.conn.rollback()
         return {r[0] for r in rows}
 
     def status(self) -> list[MigrationStatus]:

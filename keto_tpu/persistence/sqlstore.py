@@ -27,7 +27,7 @@ import threading
 import time
 import uuid
 from contextlib import contextmanager
-from typing import Callable, Optional, Sequence
+from typing import Optional, Sequence
 
 from ..namespace.definitions import NamespaceManager
 from ..relationtuple.definitions import (
@@ -43,6 +43,7 @@ from ..utils.pagination import (
     decode_page_token,
     encode_page_token,
 )
+from ..store.notify import OrderedNotifier
 from .dialect import SQLDialect
 
 _MIGRATIONS_DIR = os.path.join(os.path.dirname(__file__), "migrations", "sql")
@@ -70,7 +71,7 @@ def _subject_columns(t: RelationTuple):
     return (None, t.subject.namespace, t.subject.object, t.subject.relation)
 
 
-class SQLTupleStore(Manager):
+class SQLTupleStore(OrderedNotifier, Manager):
     # NOT fork-shareable: replicas re-applying deltas over fork-inherited
     # connections would double-commit against the shared database
     process_private = False
@@ -99,8 +100,7 @@ class SQLTupleStore(Manager):
             self.network_id = network_id
         else:
             self.network_id = self._determine_network()
-        self._listeners: list[Callable[[int], None]] = []
-        self._delta_listeners: list[Callable] = []
+        self._init_notify()
 
     # -- low-level helpers -----------------------------------------------------
 
@@ -157,27 +157,12 @@ class SQLTupleStore(Manager):
             self._conn.rollback()  # read-only: release the snapshot
             return row[0] if row else 0
 
-    def subscribe(self, fn: Callable[[int], None]) -> None:
-        self._listeners.append(fn)
-
-    def subscribe_deltas(self, fn: Callable) -> None:
-        self._delta_listeners.append(fn)
-
-    def unsubscribe_deltas(self, fn) -> None:
-        try:
-            self._delta_listeners.remove(fn)
-        except ValueError:
-            pass
+    # subscribe/subscribe_deltas/unsubscribe_deltas come from
+    # OrderedNotifier: deltas enqueue under the write lock, deliver in
+    # strict version order.
 
     def _bump_locked(self) -> int:
-        cur = self._exec(self.dialect.bump_version_sql(), (self.network_id,))
-        return cur.fetchone()[0]
-
-    def _notify(self, version, inserted=None, deleted=None) -> None:
-        for fn in self._listeners:
-            fn(version)
-        for fn in self._delta_listeners:
-            fn(version, inserted or [], deleted or [])
+        return self.dialect.bump_version(self._exec, self.network_id)
 
     # -- validation ------------------------------------------------------------
 
@@ -284,30 +269,41 @@ class SQLTupleStore(Manager):
     def write_relation_tuples(self, *tuples: RelationTuple) -> None:
         for t in tuples:
             self._validate(t)
-        with self._lock, self._txn():
-            fresh = [t for t in tuples if self._insert_locked(t)]
-            v = self._bump_locked()
-        self._notify(v, inserted=fresh)
+        with self._lock:
+            with self._txn():
+                fresh = [t for t in tuples if self._insert_locked(t)]
+                v = self._bump_locked()
+            # enqueue only AFTER commit (still under the lock, preserving
+            # version order): a rolled-back write must never surface a
+            # phantom delta to replicas/overlays
+            self._enqueue_notification(v, inserted=fresh)
+        self._drain_notifications(upto=v)
 
     def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
-        with self._lock, self._txn():
-            gone = [t for t in tuples if self._delete_locked(t)]
-            v = self._bump_locked()
-        self._notify(v, deleted=gone)
+        with self._lock:
+            with self._txn():
+                gone = [t for t in tuples if self._delete_locked(t)]
+                v = self._bump_locked()
+            self._enqueue_notification(v, deleted=gone)
+        self._drain_notifications(upto=v)
 
     def delete_all_relation_tuples(self, query: RelationQuery) -> None:
         where, params = self._where(query)
-        with self._lock, self._txn():
-            rows = self._exec(
-                f"SELECT {_TUPLE_COLUMNS} "
-                f"FROM keto_relation_tuples WHERE {where} ORDER BY seq",
-                params,
-            ).fetchall()
-            self._exec(
-                f"DELETE FROM keto_relation_tuples WHERE {where}", params
+        with self._lock:
+            with self._txn():
+                rows = self._exec(
+                    f"SELECT {_TUPLE_COLUMNS} "
+                    f"FROM keto_relation_tuples WHERE {where} ORDER BY seq",
+                    params,
+                ).fetchall()
+                self._exec(
+                    f"DELETE FROM keto_relation_tuples WHERE {where}", params
+                )
+                v = self._bump_locked()
+            self._enqueue_notification(
+                v, deleted=[_row_to_tuple(r) for r in rows]
             )
-            v = self._bump_locked()
-        self._notify(v, deleted=[_row_to_tuple(r) for r in rows])
+        self._drain_notifications(upto=v)
 
     def transact_relation_tuples(
         self,
@@ -316,11 +312,13 @@ class SQLTupleStore(Manager):
     ) -> None:
         for t in insert:
             self._validate(t)
-        with self._lock, self._txn():
-            fresh = [t for t in insert if self._insert_locked(t)]
-            gone = [t for t in delete if self._delete_locked(t)]
-            v = self._bump_locked()
-        self._notify(v, inserted=fresh, deleted=gone)
+        with self._lock:
+            with self._txn():
+                fresh = [t for t in insert if self._insert_locked(t)]
+                gone = [t for t in delete if self._delete_locked(t)]
+                v = self._bump_locked()
+            self._enqueue_notification(v, inserted=fresh, deleted=gone)
+        self._drain_notifications(upto=v)
 
     # -- snapshot support ------------------------------------------------------
 

@@ -12,6 +12,7 @@ from keto_tpu.persistence import (
     SQLiteDialect,
     dialect_for_dsn,
 )
+from keto_tpu.persistence.dialect import CockroachDialect, MySQLDialect
 from keto_tpu.persistence.migrator import load_migrations
 from keto_tpu.persistence.sqlstore import _MIGRATIONS_DIR
 
@@ -31,6 +32,11 @@ class TestDialects:
         pg = PostgresDialect().insert_ignore("t", cols)
         assert "ON CONFLICT DO NOTHING" in pg and "INSERT INTO t" in pg
 
+    def test_mysql_spellings(self):
+        my = MySQLDialect()
+        assert "INSERT IGNORE INTO t" in my.insert_ignore("t", ("a", "b"))
+        assert my.sql("a = ?") == "a = %s"
+
     def test_dsn_dispatch(self):
         d, native = dialect_for_dsn("memory")
         assert d.name == "sqlite" and native == ":memory:"
@@ -38,22 +44,72 @@ class TestDialects:
         assert d.name == "sqlite" and native == "/tmp/x.db"
         d, native = dialect_for_dsn("postgres://u:p@h/db")
         assert d.name == "postgres" and native == "postgres://u:p@h/db"
+        d, native = dialect_for_dsn("cockroach://u@h:26257/db")
+        assert d.name == "cockroach" and native == "postgres://u@h:26257/db"
+        d, native = dialect_for_dsn("mysql://u:p@h/db")
+        assert d.name == "mysql"
         with pytest.raises(ValueError):
             dialect_for_dsn("mongodb://nope")
 
-    def test_postgres_connect_without_driver_raises_clearly(self):
-        has_driver = True
+    def test_four_dialects_registered(self):
+        # the reference persister's engine matrix
+        # (internal/persistence/sql/persister.go:50-51)
+        assert set(DIALECTS) == {"sqlite", "postgres", "cockroach", "mysql"}
+
+    def test_postgres_connect_falls_back_to_wire_driver(self, pgfake_server):
+        """Without psycopg, the dialect connects through the in-tree v3
+        wire driver — the postgres path works in the bare image."""
+        conn = PostgresDialect().connect(
+            f"postgres://keto@127.0.0.1:{pgfake_server.port}/wiretest"
+        )
         try:
-            import psycopg  # noqa: F401
-        except ImportError:
-            try:
-                import psycopg2  # noqa: F401
-            except ImportError:
-                has_driver = False
-        if has_driver:
-            pytest.skip("a postgres driver exists in this image")
-        with pytest.raises(RuntimeError, match="no postgres driver"):
-            PostgresDialect().connect("postgres://localhost/x")
+            cur = conn.cursor()
+            cur.execute("SELECT %s + %s", (20, 22))
+            assert cur.fetchone()[0] == 42
+            conn.rollback()
+        finally:
+            conn.close()
+
+    def test_wire_driver_types_and_rowcount(self, pgfake_server):
+        from keto_tpu.persistence import pgwire
+
+        conn = pgwire.connect(
+            f"postgres://keto@127.0.0.1:{pgfake_server.port}/wiretypes"
+        )
+        try:
+            cur = conn.cursor()
+            cur.execute(
+                "CREATE TABLE t (n BIGINT, x DOUBLE PRECISION, s TEXT)"
+            )
+            cur.execute(
+                "INSERT INTO t VALUES (%s, %s, %s), (%s, %s, %s)",
+                (1, 1.5, "it's", 2, None, None),
+            )
+            assert cur.rowcount == 2
+            conn.commit()
+            cur.execute("SELECT n, x, s FROM t ORDER BY n")
+            rows = cur.fetchall()
+            assert rows == [(1, 1.5, "it's"), (2, None, None)]
+            conn.rollback()
+        finally:
+            conn.close()
+
+    def test_wire_driver_error_surfaces_and_recovers(self, pgfake_server):
+        from keto_tpu.persistence import pgwire
+
+        conn = pgwire.connect(
+            f"postgres://keto@127.0.0.1:{pgfake_server.port}/wireerr"
+        )
+        try:
+            with pytest.raises(pgwire.Error):
+                conn.cursor().execute("SELECT * FROM missing_table")
+            conn.rollback()
+            cur = conn.cursor()
+            cur.execute("SELECT %s", ("ok",))
+            assert cur.fetchone() == ("ok",)
+            conn.rollback()
+        finally:
+            conn.close()
 
 
 class TestMigrationOverlays:
@@ -87,11 +143,32 @@ class TestMigrationOverlays:
         assert "AUTOINCREMENT" in sq["20220101000000"].up_sql
 
     def test_overlay_file_naming_is_complete(self):
-        """Every *.postgres.*.sql has a generic twin (else a dialect would
-        silently gain a migration others lack)."""
+        """Every per-dialect overlay has a generic twin (else a dialect
+        would silently gain a migration others lack)."""
         for fname in os.listdir(_MIGRATIONS_DIR):
-            if ".postgres." in fname:
-                twin = fname.replace(".postgres.", ".")
-                assert os.path.exists(
-                    os.path.join(_MIGRATIONS_DIR, twin)
-                ), f"{fname} has no generic twin {twin}"
+            for marker in (".postgres.", ".mysql.", ".cockroach."):
+                if marker in fname:
+                    twin = fname.replace(marker, ".")
+                    assert os.path.exists(
+                        os.path.join(_MIGRATIONS_DIR, twin)
+                    ), f"{fname} has no generic twin {twin}"
+
+    def test_mysql_and_cockroach_overlays_load(self):
+        v0 = "20220101000000"
+        my = {
+            m.version: m
+            for m in load_migrations(
+                _MIGRATIONS_DIR, dialect=DIALECTS["mysql"]
+            )
+        }
+        assert "AUTO_INCREMENT" in my[v0].up_sql
+        cr = {
+            m.version: m
+            for m in load_migrations(
+                _MIGRATIONS_DIR, dialect=DIALECTS["cockroach"]
+            )
+        }
+        assert "BIGSERIAL" in cr[v0].up_sql
+        # same version ladder everywhere
+        generic = {m.version for m in load_migrations(_MIGRATIONS_DIR)}
+        assert set(my) == set(cr) == generic
