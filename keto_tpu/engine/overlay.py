@@ -16,14 +16,25 @@ Decompose every write by where its edge sits (keto_tpu/graph/interior.py):
   — monotone in-place, so concurrent readers see answers between the old
   and new version, never wrong about both). New interior NODES take a
   spare index from D's INF padding (diag zeroed) — growth without rebuild.
-- **interior edge deletes** (and overlay overflow): the one case a
-  closure cannot absorb incrementally — distances may shrink-only-patch,
-  never grow. The overlay marks itself BROKEN and the engine falls back
-  to the rebuild path (bounded: serve the stale snapshot while the
-  background rebuild runs; strong: rebuild before the next answer).
-  Breaking deltas are rejected whole (two-phase apply), so a broken
-  overlay still exactly describes its last covered version — pinned
-  readers keep getting consistent answers while the rebuild runs.
+- **interior edge deletes** (a group losing a nested group): absorbed by
+  a bounded exact RE-CLOSE of the affected D rows (VERDICT r4 weak #3 —
+  this used to cost a full multi-minute rebuild at 100M). Removing edge
+  (u,v) can only lengthen distances for rows i whose shortest path used
+  it, i.e. rows where ``D[i,u] + 1 + D[v,j] == D[i,j]`` for some j. Those
+  rows are recomputed from scratch against the CURRENT interior
+  adjacency (base CSR + overlay-inserted − overlay-deleted edges): one
+  min-plus step through unaffected rows (whose distances are final),
+  then ≤ k_max relaxation sweeps over affected→affected edges. Exact;
+  cost O(|R| · deg · M). A delete whose candidate row set exceeds
+  ``max_delete_rows`` breaks the overlay instead (rebuild path) — the
+  budget bounds worst-case write stall, not correctness.
+- **overlay overflow** (budgets exhausted): the overlay marks itself
+  BROKEN and the engine falls back to the rebuild path (bounded: serve
+  the stale snapshot while the background rebuild runs; strong: rebuild
+  before the next answer). Breaking deltas are rejected whole (two-phase
+  apply), so a broken overlay still exactly describes its last covered
+  version — pinned readers keep getting consistent answers while the
+  rebuild runs.
 
 Both D residencies are supported: the host copy is patched in place
 (numpy, monotone), a device-resident D via jax's immutable-update ops
@@ -76,17 +87,28 @@ class WriteOverlay:
         art,
         max_events: int = 65536,
         max_interior_edges: int = 64,
+        max_delete_rows: int = 1024,
     ):
         self.art = art
         self.version = art.version
         self.max_events = max_events
         self.max_interior_edges = max_interior_edges
+        self.max_delete_rows = max_delete_rows
         self.broken = False
         self.broken_reason = ""
         self.n_events = 0
         self.n_interior_edges = 0
+        self.n_interior_deletes = 0
         self._lock = threading.Lock()
         self._pending: deque = deque()
+        # current interior adjacency in D-index space, for the delete
+        # re-close: the delta dict tracks overlay-inserted (+1) / deleted
+        # (-1) edges over the base ii edge list (edge multiplicity is 1: a
+        # (src,dst) index pair maps 1:1 to a relation tuple, which the
+        # stores dedup); the grouped-edge cache is rebuilt lazily after
+        # any delta change
+        self._int_edge_delta: dict[int, int] = {}  # pair key -> net ±1
+        self._int_edges_cache: Optional[tuple] = None
         # net per-edge deltas: +1 overlay-added, -1 base-edge deleted
         self.f0_delta: dict[int, dict[int, int]] = {}  # start -> idx -> ±1
         self.l_delta: dict[int, dict[int, int]] = {}  # target -> idx -> ±1
@@ -164,6 +186,9 @@ class WriteOverlay:
             art.d = art.d.at[idx, idx].set(0)
 
     def _d_insert_edge(self, u: int, v: int) -> None:
+        # record for the delete re-close's current-adjacency view
+        self._bump(self._int_edge_delta, _pair_key(u, v), +1)
+        self._int_edges_cache = None
         art = self.art
         if art.d_host is not None:
             closure_insert_edge_host(art.d_host, u, v, art.k_max)
@@ -195,6 +220,192 @@ class WriteOverlay:
                 ].min()
             )
         )
+
+    def _d_col(self, u: int) -> np.ndarray:
+        art = self.art
+        if art.d_host is not None:
+            return art.d_host[:, u]
+        return np.asarray(art.d[:, u])
+
+    def _d_row_vec(self, v: int) -> np.ndarray:
+        art = self.art
+        if art.d_host is not None:
+            return art.d_host[v, :]
+        return np.asarray(art.d[v, :])
+
+    def _d_full_rows(self, rows: np.ndarray) -> np.ndarray:
+        art = self.art
+        if art.d_host is not None:
+            return art.d_host[rows.astype(np.int64)]
+        return np.asarray(art.d[np.asarray(rows, np.int32)])
+
+    def _d_set_rows(self, rows: np.ndarray, vals: np.ndarray) -> None:
+        art = self.art
+        if art.d_host is not None:
+            # uint8 stores are per-entry atomic: concurrent readers see
+            # each (i,j) either pre- or post-delete — the same
+            # between-versions guarantee the monotone insert path gives
+            art.d_host[rows.astype(np.int64)] = vals
+        else:
+            import jax.numpy as jnp
+
+            art.d = art.d.at[jnp.asarray(rows, jnp.int32)].set(
+                jnp.asarray(vals)
+            )
+
+    def _d_set_cols(self, cols: np.ndarray, vals: np.ndarray) -> None:
+        art = self.art
+        if art.d_host is not None:
+            art.d_host[:, cols.astype(np.int64)] = vals
+        else:
+            import jax.numpy as jnp
+
+            art.d = art.d.at[:, jnp.asarray(cols, jnp.int32)].set(
+                jnp.asarray(vals)
+            )
+
+    # -- current interior adjacency (for the delete re-close) ------------------
+
+    def _current_int_edges(self):
+        """(src, dst, uniq_src, group_starts) over the CURRENT interior
+        edge list — base ii edges with the overlay's net deltas applied,
+        sorted+grouped by src for reduceat sweeps. Cached; invalidated on
+        any interior-edge insert/delete."""
+        if self._int_edges_cache is not None:
+            return self._int_edges_cache
+        ig = self.art.ig
+        src = ig.ii_src.astype(np.int64)
+        dst = ig.ii_dst.astype(np.int64)
+        if self._int_edge_delta:
+            keys = (src << _PAIR_SHIFT) | dst
+            removed = np.fromiter(
+                (k for k, n in self._int_edge_delta.items() if n < 0),
+                np.int64,
+            )
+            if removed.size:
+                keep = ~np.isin(keys, removed)
+                src, dst = src[keep], dst[keep]
+            added = np.fromiter(
+                (k for k, n in self._int_edge_delta.items() if n > 0),
+                np.int64,
+            )
+            if added.size:
+                src = np.concatenate([src, added >> _PAIR_SHIFT])
+                dst = np.concatenate(
+                    [dst, added & ((1 << _PAIR_SHIFT) - 1)]
+                )
+        by_src = np.argsort(src, kind="stable")
+        src_s, dst_s = src[by_src], dst[by_src]
+        uniq_src, starts_src = np.unique(src_s, return_index=True)
+        by_dst = np.argsort(dst, kind="stable")
+        src_d, dst_d = src[by_dst], dst[by_dst]
+        uniq_dst, starts_dst = np.unique(dst_d, return_index=True)
+        self._int_edges_cache = (
+            (src_s, dst_s, uniq_src, starts_src),  # grouped by src
+            (src_d, dst_d, uniq_dst, starts_dst),  # grouped by dst
+        )
+        return self._int_edges_cache
+
+    def _sweep_rows(self, init_rows: np.ndarray) -> np.ndarray:
+        """Exact bounded distances FROM each node in init_rows over the
+        current interior edges: batched Bellman-Ford, k_max sweeps of
+        grouped min-plus (paths are <= k_max hops by construction).
+        Returns uint8 (len(init_rows), m_pad) with INF_DIST beyond k_max."""
+        art = self.art
+        _, (src, dst, uniq, starts) = self._current_int_edges()
+        BIG = np.int16(1 << 14)
+        est = np.full((len(init_rows), art.m_pad), BIG, np.int16)
+        est[np.arange(len(init_rows)), init_rows] = 0
+        if len(src):
+            for _ in range(art.k_max):
+                # relax dist(i -> j) >= dist(i -> w) + 1 for edges w->j:
+                # fixed sources advance along IN-edges of each target,
+                # so the reduceat groups by dst
+                mins = np.minimum.reduceat(
+                    est[:, src] + np.int16(1), starts, axis=1
+                )
+                new = np.minimum(est[:, uniq], mins)
+                if (new >= est[:, uniq]).all():
+                    break
+                est[:, uniq] = new
+        return np.where(
+            est > art.k_max, np.int16(INF_DIST), est
+        ).astype(np.uint8)
+
+    def _sweep_cols(self, init_cols: np.ndarray) -> np.ndarray:
+        """Exact bounded distances TO each node in init_cols (one D column
+        per target), same sweep transposed: fixed targets advance along
+        OUT-edges of each source, so the reduceat groups by src. Returns
+        uint8 (m_pad, len(init_cols))."""
+        art = self.art
+        (src, dst, uniq, starts), _ = self._current_int_edges()
+        BIG = np.int16(1 << 14)
+        dist = np.full((art.m_pad, len(init_cols)), BIG, np.int16)
+        dist[init_cols, np.arange(len(init_cols))] = 0
+        if len(src):
+            for _ in range(art.k_max):
+                # relax dist(u -> t) >= 1 + dist(v -> t) for edges u->v
+                mins = np.minimum.reduceat(
+                    dist[dst] + np.int16(1), starts, axis=0
+                )
+                new = np.minimum(dist[uniq], mins)
+                if (new >= dist[uniq]).all():
+                    break
+                dist[uniq] = new
+        return np.where(
+            dist > art.k_max, np.int16(INF_DIST), dist
+        ).astype(np.uint8)
+
+    def _delete_interior_edge(self, u: int, v: int) -> None:
+        """Exact bounded re-close of D after removing interior edge (u,v)
+        (VERDICT r4 weak #3 — this used to force a full O(M^3) rebuild).
+
+        Removing an edge can only LENGTHEN distances, and only for pairs
+        (i,j) whose shortest path used it: pairs where D[i,u] + 1 +
+        D[v,j] == D[i,j]. The tight pairs project onto affected ROWS
+        (sources reaching u) and affected COLUMNS (targets reachable from
+        v); recomputing either side from scratch restores exactness, so
+        pick whichever projection is smaller and run a batched k_max-sweep
+        Bellman-Ford over the current interior edge list. Typical RBAC
+        deletes (a group losing a leaf-ish nested group) affect one or a
+        handful of columns — microseconds-to-milliseconds, not the
+        multi-minute rebuild."""
+        art = self.art
+        k_max = art.k_max
+
+        # 1. tight projections (against D BEFORE any mutation)
+        du = self._d_col(u).astype(np.int16)
+        dv = self._d_row_vec(v).astype(np.int16)
+        cand_rows = np.nonzero(du <= k_max)[0]
+        row_hits = []
+        col_hit = np.zeros(art.m_pad, dtype=bool)
+        CH = 512
+        for c0 in range(0, len(cand_rows), CH):
+            chunk = cand_rows[c0 : c0 + CH]
+            sub = self._d_full_rows(chunk).astype(np.int16)
+            tight = (du[chunk][:, None] + 1 + dv[None, :]) == sub
+            hit = tight.any(axis=1)
+            if hit.any():
+                row_hits.append(chunk[hit])
+                col_hit |= tight.any(axis=0)
+
+        # 2. drop the edge from the current-adjacency view
+        self._bump(self._int_edge_delta, _pair_key(u, v), -1)
+        self._int_edges_cache = None
+        self.n_interior_deletes += 1
+        if not row_hits:
+            return  # no shortest path used the edge: D is already exact
+
+        # 3. recompute the smaller projection
+        R = np.concatenate(row_hits)
+        C = np.nonzero(col_hit)[0]
+        if len(C) <= len(R):
+            self._d_set_cols(C, self._sweep_cols(C))
+        else:
+            # chunk rows to bound the (rows x edges) sweep working set
+            for c0 in range(0, len(R), 256):
+                chunk = R[c0 : c0 + 256]
+                self._d_set_rows(chunk, self._sweep_rows(chunk))
 
     def _base_out_neighbors(self, nid: int) -> np.ndarray:
         """One node's base successors in insertion order. Uses the
@@ -305,6 +516,7 @@ class WriteOverlay:
         n_grow = 0
         n_int_edges = self.n_interior_edges
         n_events = self.n_events
+        n_del_rows = 0  # candidate re-close rows this delta would pay for
 
         def interior(nid: int) -> bool:
             return self._interior_index_of(nid) >= 0 or nid in hypo_interior
@@ -313,7 +525,30 @@ class WriteOverlay:
             n_events += 1
             if kind == "del":
                 if is_set and interior(s):
-                    return "interior edge delete"
+                    # interior edge delete: absorbed by the bounded
+                    # re-close. Charge the SMALLER projection of the
+                    # candidate tight set — rows reaching s vs columns
+                    # reachable from d — matching the orientation the
+                    # re-close will pick. A node promoted earlier in this
+                    # same delta has no D row/column yet; its reach is
+                    # bounded by the delta's own inserts, charge 1.
+                    s_idx = self._interior_index_of(s)
+                    d_idx = self._interior_index_of(d)
+                    k_max = self.art.k_max
+                    if s_idx >= 0 and d_idx >= 0:
+                        n_rows = int(
+                            np.count_nonzero(self._d_col(s_idx) <= k_max)
+                        )
+                        n_cols = int(
+                            np.count_nonzero(
+                                self._d_row_vec(d_idx) <= k_max
+                            )
+                        )
+                        n_del_rows += min(n_rows, n_cols)
+                    else:
+                        n_del_rows += 1
+                    if n_del_rows > self.max_delete_rows:
+                        return "interior delete too wide"
                 continue
             if not is_set:
                 continue
@@ -371,6 +606,10 @@ class WriteOverlay:
                     # interior edge: exact O(M^2) relaxation into D
                     self.n_interior_edges += 1
                     self._d_insert_edge(s_idx, d_idx)
+                elif kind == "del" and s_idx >= 0 and d_idx >= 0:
+                    # interior edge delete: bounded exact re-close of the
+                    # affected D rows (budgeted in _plan_breaks)
+                    self._delete_interior_edge(s_idx, d_idx)
             else:
                 s_idx = self._interior_index_of(s)
                 if s_idx >= 0:
