@@ -324,6 +324,50 @@ def _run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_ki
             enc_rps = max(enc_rps, batch * iters / (time.time() - t0))
         gc.enable()
 
+    # device-resident query leg (VERDICT r5 #3): the SAME resident closure
+    # served with query_mode=device — one D upload, no second O(M^3) build.
+    # Captures a measured RPS/latency row for the TPU serving path next to
+    # the host path so the host/device crossover is data, not stance.
+    device_meta = {}
+    if (
+        os.environ.get("BENCH_DEVICE_LEG", "1") == "1"
+        and hasattr(engine, "device_view")
+        and isinstance(getattr(engine, "_state", None), _ClosureArtifacts)
+    ):
+        try:
+            dview = engine.device_view()
+            dev_batches = batches[: min(iters, 10)]
+            dview.batch_check(dev_batches[0])  # compile
+            dview.batch_check(dev_batches[0])
+            gc.collect()
+            gc.disable()
+            dev_rps = 0.0
+            dev_lat: list = []
+            for _pass in range(2):
+                pass_lat = []
+                t_all = time.time()
+                for reqs in dev_batches:
+                    t0 = time.time()
+                    dview.batch_check(reqs)
+                    pass_lat.append(time.time() - t0)
+                pass_rps = batch * len(dev_batches) / (time.time() - t_all)
+                if pass_rps > dev_rps:
+                    dev_rps, dev_lat = pass_rps, pass_lat
+            gc.enable()
+            device_meta = {
+                "device_check_rps": round(dev_rps),
+                "device_batch_p50_ms": round(
+                    1000 * float(np.percentile(dev_lat, 50)), 2
+                ),
+                "device_batch_p95_ms": round(
+                    1000 * float(np.percentile(dev_lat, 95)), 2
+                ),
+            }
+            del dview
+        except Exception as e:
+            gc.enable()
+            device_meta = {"device_leg_error": repr(e)[:200]}
+
     # expand: host tree walk over the resident CSR. Freeze the resident
     # graph out of the cyclic GC first, exactly like the serving registry
     # does at boot (registry.start_all): tree construction allocates
@@ -361,6 +405,7 @@ def _run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_ki
         "allowed_frac": round(n_allowed / (batch * iters), 3),
         "rss_gb": _rss_gb(),
     }
+    meta.update(device_meta)
     state = getattr(engine, "_state", None)
     if isinstance(state, _ClosureArtifacts):
         meta["interior_nodes"] = int(state.ig.m)
@@ -386,12 +431,15 @@ def _run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_ki
 
 
 def run_write_bench(name, store, engine, sample, to_requests):
-    """Freshness under writes (VERDICT r3 #3): interleave inserts+deletes
-    with checks and measure write->fresh-answer staleness. Leaf writes ride
-    the serving-time overlay (engine/overlay.py); a few interior-edge
-    inserts exercise the in-place O(M^2) closure patch. Reports staleness
-    percentiles, snaptoken-wait 503s (must be 0), whether any write forced
-    a closure rebuild, and sustained check RPS during the write phase."""
+    """Freshness under writes (VERDICT r3 #3 / r4 #4): interleave
+    inserts+deletes with checks and measure write->fresh-answer staleness.
+    Leaf writes ride the serving-time overlay (engine/overlay.py);
+    interior-edge INSERTS exercise the in-place O(M^2) closure patch;
+    interior-edge DELETES (a group losing a nested group — the r4 rebuild
+    cliff) exercise the bounded exact re-close. Reports staleness
+    percentiles overall AND for the interior-delete subset, snaptoken-wait
+    503s (must be 0), whether any write forced a closure rebuild, and
+    sustained check RPS during the write phase."""
     from keto_tpu.relationtuple import RelationTuple, SubjectSet
     from keto_tpu.utils.errors import ErrUnavailable
 
@@ -399,11 +447,14 @@ def run_write_bench(name, store, engine, sample, to_requests):
     cycles = int(os.environ.get("BENCH_WRITE_CYCLES", 12))
     batch = 1024
     stale_ms: list = []
+    int_del_stale_ms: list = []
     n_503 = 0
     n_checks = 0
     n_wrong = 0
+    n_interior_deletes = 0
     builds0 = engine.n_full_builds + engine.n_incremental_builds
     check_batches = [to_requests(*sample(rng, batch)) for _ in range(4)]
+    interior_edges: list = []  # inserted nestings, deleted in later cycles
     t_phase = time.time()
     for cycle in range(cycles):
         fresh = [
@@ -427,28 +478,37 @@ def run_write_bench(name, store, engine, sample, to_requests):
         ]
         if cycle % 4 == 0:
             # interior edge: an existing group gains a nested group
-            fresh.append(
-                RelationTuple(
-                    namespace="rbac",
-                    object=f"g{rng.integers(20)}",
+            nest = RelationTuple(
+                namespace="rbac",
+                object=f"g{rng.integers(20)}",
+                relation="member",
+                subject=SubjectSet(
+                    namespace="rbac", object=f"wg{cycle}",
                     relation="member",
-                    subject=SubjectSet(
-                        namespace="rbac", object=f"wg{cycle}",
-                        relation="member",
-                    ),
-                )
+                ),
             )
-        for op, tuples in (("ins", fresh), ("del", fresh[:1])):
+            fresh.append(nest)
+            interior_edges.append(nest)
+        ops = [("ins", fresh), ("del", fresh[:1])]
+        if cycle % 4 == 2 and interior_edges:
+            # interior-edge delete: the r4 full-rebuild cliff, now the
+            # bounded re-close — measured as its own staleness bucket
+            ops.append(("del-interior", [interior_edges.pop(0)]))
+        for op, tuples in ops:
             t0 = time.perf_counter()
             if op == "ins":
                 store.write_relation_tuples(*tuples)
             else:
                 store.delete_relation_tuples(*tuples)
             try:
-                engine.wait_for_version(store.version, timeout_s=30.0)
+                engine.wait_for_version(store.version, timeout_s=120.0)
             except ErrUnavailable:
                 n_503 += 1
-            stale_ms.append(1000 * (time.perf_counter() - t0))
+            dt_ms = 1000 * (time.perf_counter() - t0)
+            stale_ms.append(dt_ms)
+            if op == "del-interior":
+                int_del_stale_ms.append(dt_ms)
+                n_interior_deletes += 1
             # correctness probe: the written/deleted tuple itself
             got = engine.subject_is_allowed(tuples[0], 1)
             if got != (op == "ins"):
@@ -462,6 +522,12 @@ def run_write_bench(name, store, engine, sample, to_requests):
         "staleness_p50_ms": round(float(np.percentile(stale_ms, 50)), 2),
         "staleness_p95_ms": round(float(np.percentile(stale_ms, 95)), 2),
         "staleness_max_ms": round(float(max(stale_ms)), 2),
+        "interior_deletes": n_interior_deletes,
+        "interior_delete_stale_p95_ms": (
+            round(float(np.percentile(int_del_stale_ms, 95)), 2)
+            if int_del_stale_ms
+            else None
+        ),
         "snaptoken_503s": n_503,
         "wrong_answers": n_wrong,
         "closure_rebuilds": (
@@ -840,7 +906,11 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
     loop_thread.join(timeout=10)
 
     pool = reg._replica_pool
-    effective_workers = 1 + (len(pool._children) if pool is not None else 0)
+    effective_workers = (
+        1
+        if pool is None
+        else 1 + len(getattr(pool, "_children", getattr(pool, "_procs", ())))
+    )
     out = {
         "config": f"{name}_server",
         # EFFECTIVE count: the registry demotes to single-process when the
@@ -1037,7 +1107,80 @@ def run_sharded_bench():
         )
 
 
+def _probe_backend(timeout_s: float) -> tuple[str | None, str | None]:
+    """Touch the JAX backend in a SUBPROCESS first: the axon TPU backend
+    HANGS (not raises) on a sick tunneled chip, so an in-process
+    ``jax.devices()`` can wedge the whole bench with no output (VERDICT r4:
+    BENCH_r04 was rc=1/parsed=null for exactly this). Returns
+    (platform, None) on success, (None, error) on failure/timeout."""
+    import subprocess
+
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ),
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"jax.devices() hung >{timeout_s:.0f}s (backend probe)"
+    if proc.returncode != 0:
+        return None, f"backend init failed rc={proc.returncode}: " + (
+            proc.stderr.strip().splitlines()[-1][-300:]
+            if proc.stderr.strip()
+            else "no stderr"
+        )
+    return proc.stdout.strip() or "unknown", None
+
+
 def main():
+    # --- backend guard (before ANY in-process jax import) ---------------
+    # A sick chip must degrade the number, not the run: on probe failure,
+    # RE-EXEC this interpreter with a clean CPU env and keep going — the
+    # host-query closure path is measured either way and the JSON line
+    # still parses. Mutating os.environ in-process is NOT enough: the
+    # axon sitecustomize registers its PJRT plugin at interpreter start,
+    # so a later jax.devices() still routes into the sick TPU backend and
+    # hangs regardless of JAX_PLATFORMS (verified on this host).
+    backend_meta = {}
+    if os.environ.get("BENCH_CPU_REEXEC") == "1":
+        backend_meta = {
+            "backend": "cpu-fallback",
+            "tpu_error": os.environ.get("BENCH_TPU_ERROR", "unknown"),
+        }
+        print(json.dumps(backend_meta), file=sys.stderr, flush=True)
+    else:
+        platform, tpu_error = _probe_backend(
+            float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 180))
+        )
+        if tpu_error is not None:
+            from __graft_entry__ import cpu_fallback_env
+
+            env = cpu_fallback_env()
+            env.update(
+                {
+                    "BENCH_CPU_REEXEC": "1",  # probe once, not forever
+                    "BENCH_TPU_ERROR": tpu_error,
+                }
+            )
+            print(
+                json.dumps(
+                    {"backend": "cpu-fallback", "tpu_error": tpu_error}
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os.execve(
+                sys.executable,
+                [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                env,
+            )
+        backend_meta = {"backend": platform}
+
     import jax
 
     batch = int(os.environ.get("BENCH_BATCH", 4096))
@@ -1062,6 +1205,7 @@ def main():
                 "device": str(jax.devices()[0]),
                 "host_cpus": os.cpu_count(),
                 "device_roundtrip_ms": rt_ms,
+                **backend_meta,
             }
         ),
         file=sys.stderr,
@@ -1078,22 +1222,44 @@ def main():
             )
             continue
         n, gen = CONFIGS[name]
-        results.append(run_config(name, n, gen, batch, iters, engine_kind))
+        try:
+            results.append(
+                run_config(name, n, gen, batch, iters, engine_kind)
+            )
+        except Exception as e:
+            # one rung failing (OOM at 100M on a small host, a flaky
+            # backend mid-ladder) must not zero the whole run's evidence
+            import traceback
+
+            traceback.print_exc()
+            print(
+                json.dumps({"config": name, "error": repr(e)[:300]}),
+                file=sys.stderr,
+                flush=True,
+            )
+            continue
         # emit the running headline after EVERY config: if the harness
         # times the run out mid-ladder, the last stdout line still carries
         # a valid result for the largest completed config
-        _print_primary(results)
+        _print_primary(results, backend_meta)
 
     if os.environ.get("BENCH_SHARDED", "1") == "1":
-        run_sharded_bench()
+        try:
+            run_sharded_bench()
+        except Exception as e:
+            print(
+                json.dumps({"config": "sharded", "error": repr(e)[:300]}),
+                file=sys.stderr,
+                flush=True,
+            )
 
     if not results:
         print("no valid bench configs ran", file=sys.stderr)
         sys.exit(1)
-    _print_primary(results)
+    _print_primary(results, backend_meta)
 
 
-def _print_primary(results):
+def _print_primary(results, backend_meta=None):
     primary = max(results, key=lambda r: r["tuples"])
     # headline: best sustained check throughput at the largest scale —
     # batch transport when serving-path numbers exist, else the engine path
@@ -1103,17 +1269,49 @@ def _print_primary(results):
         primary.get("batch_rps") or 0,
         primary.get("grpc_batch_rps") or 0,
     )
-    print(
-        json.dumps(
-            {
-                "metric": "check_rps",
-                "value": value,
-                "unit": "checks/s",
-                "vs_baseline": round(value / 1_000_000, 4),
-            }
+    line = {
+        "metric": "check_rps",
+        "value": value,
+        "unit": "checks/s",
+        "vs_baseline": round(value / 1_000_000, 4),
+        # the full evidence payload rides the ONE parsed line (VERDICT r4
+        # demanded p95/expand/staleness in the parsed JSON, not the log)
+        "config": primary.get("config"),
+        "tuples": primary.get("tuples"),
+        "batch_p95_ms": primary.get("batch_p95_ms"),
+        "expand_p95_ms": primary.get("expand_p95_ms"),
+        "staleness_p95_ms": primary.get("staleness_p95_ms"),
+        "interior_delete_stale_p95_ms": primary.get(
+            "interior_delete_stale_p95_ms"
         ),
-        flush=True,
-    )
+        "closure_rebuilds": primary.get("closure_rebuilds"),
+        "snaptoken_503s": primary.get("snaptoken_503s"),
+        "grpc_batch_rps": primary.get("grpc_batch_rps"),
+        "batch_rps": primary.get("batch_rps"),
+        "query_mode": primary.get("query_mode"),
+        "device_check_rps": primary.get("device_check_rps"),
+        "device_batch_p95_ms": primary.get("device_batch_p95_ms"),
+        "all_configs": [
+            {
+                k: r.get(k)
+                for k in (
+                    "config",
+                    "tuples",
+                    "check_rps",
+                    "check_rps_encoded",
+                    "batch_p95_ms",
+                    "expand_p95_ms",
+                    "staleness_p95_ms",
+                    "query_mode",
+                    "device_check_rps",
+                    "device_batch_p95_ms",
+                )
+            }
+            for r in results
+        ],
+        **(backend_meta or {}),
+    }
+    print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

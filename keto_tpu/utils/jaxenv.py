@@ -1,0 +1,43 @@
+"""Subprocess JAX environment recipes — the ONE place the axon-skip
+knowledge lives.
+
+On hosts with the axon TPU plugin, a sitecustomize registers the PJRT
+plugin at interpreter start whenever ``PALLAS_AXON_POOL_IPS`` is set; on a
+sick tunneled chip any later backend touch HANGS rather than raises, and
+nothing can undo a registration after interpreter start. Every consumer
+that needs a hermetic CPU interpreter therefore builds its env from here:
+the test conftest's re-exec, the bench's sick-chip fallback, the multichip
+dryrun bootstrap, and the spawn-worker pool.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def cpu_fallback_env() -> dict:
+    """Fresh-interpreter environment with the axon TPU plugin skipped and
+    the CPU platform forced."""
+    env = dict(os.environ)
+    env.update(
+        {
+            "PALLAS_AXON_POOL_IPS": "",  # sitecustomize skips registration
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    return env
+
+
+def virtual_cpu_mesh_env(n_devices: int) -> dict:
+    """`cpu_fallback_env` plus an n-device virtual CPU mesh: the
+    device-count flag is spliced into any operator-set XLA_FLAGS (append,
+    never overwrite — clobbering would drop their flags)."""
+    env = cpu_fallback_env()
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env

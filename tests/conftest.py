@@ -12,6 +12,41 @@ start (see test_sharded_subprocess.py).
 """
 
 import os
+import sys
+
+
+def pytest_configure(config):
+    """Re-exec pytest ONCE with a clean hermetic env when the axon TPU
+    plugin was registered at interpreter start (PALLAS_AXON_POOL_IPS set).
+    On a sick tunneled chip any jax backend touch HANGS instead of raising
+    and wedges the whole suite (VERDICT r4); env mutation after interpreter
+    start cannot undo the registration, so a fresh exec with axon skipped +
+    CPU platform + 8 virtual devices is the only reliable fix. Runs in
+    pytest_configure (not at import) so global FD capture can be stopped
+    first — exec'ing mid-capture sends the new process's output into
+    pytest's about-to-vanish capture temp files."""
+    if not (
+        os.environ.get("PALLAS_AXON_POOL_IPS")
+        and os.environ.get("KETO_TEST_REEXEC") != "1"
+    ):
+        return
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    from __graft_entry__ import virtual_cpu_mesh_env
+
+    env = virtual_cpu_mesh_env(8)
+    env["KETO_TEST_REEXEC"] = "1"
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(
+        sys.executable,
+        [sys.executable, "-m", "pytest", *sys.argv[1:]],
+        env,
+    )
+
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
@@ -31,13 +66,33 @@ def nsmgr():
     return MemoryNamespaceManager()
 
 
-@pytest.fixture(params=["memory", "sqlite", "columnar", "postgres"])
+@pytest.fixture(scope="session")
+def pgfake_server():
+    """One in-tree fake postgres (wire protocol over sqlite) per session;
+    each test leg opens its own logical database on it."""
+    from keto_tpu.persistence.pgfake import start_server
+
+    srv = start_server()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(
+    params=["memory", "sqlite", "columnar", "postgres", "mysql", "cockroach"]
+)
 def store(request, nsmgr, tmp_path):
     """Every contract/engine test runs against all persistence backends —
-    the reference's one-suite-many-DSNs matrix (SURVEY.md §4). The postgres
-    leg runs only when KETO_TEST_PG_DSN points at a live server AND a
-    psycopg driver exists (the reference's equivalent: -short skips its
-    dockertest engines, internal/x/dbx/dsn_testutils.go:36-43)."""
+    the reference's one-suite-many-DSNs matrix across 4 SQL engines
+    (SURVEY.md §4; internal/persistence/sql/persister.go:50-51). The
+    postgres/cockroach legs speak the real v3 wire protocol through the
+    in-tree driver (pgwire.py) — against a live server when
+    KETO_TEST_PG_DSN is set, else against the in-tree fake (pgfake.py),
+    the same role the reference's dockertest containers play
+    (internal/x/dbx/dsn_testutils.go:45-61). The mysql leg runs the MySQL
+    dialect SQL through the DB-API translation shim (mysqlfake.py) unless
+    KETO_TEST_MYSQL_DSN points at a real server."""
+    import uuid as _uuid
+
     if request.param == "memory":
         yield InMemoryTupleStore(namespace_manager=nsmgr)
         return
@@ -47,21 +102,62 @@ def store(request, nsmgr, tmp_path):
         yield ColumnarTupleStore(namespace_manager=nsmgr)
         return
     if request.param == "postgres":
-        dsn = os.environ.get("KETO_TEST_PG_DSN")
-        if not dsn:
-            pytest.skip("postgres: set KETO_TEST_PG_DSN to run")
         from keto_tpu.persistence.postgres import PostgresTupleStore
 
-        try:
+        dsn = os.environ.get("KETO_TEST_PG_DSN")
+        fresh = dsn is None
+        if fresh:
+            srv = request.getfixturevalue("pgfake_server")
+            dsn = (
+                f"postgres://keto@127.0.0.1:{srv.port}"
+                f"/pg_{_uuid.uuid4().hex[:12]}"
+            )
             s = PostgresTupleStore(dsn, namespace_manager=nsmgr)
-        except Exception as e:
-            # no driver (RuntimeError) or unreachable server (driver's
-            # OperationalError): a visible skip, not a matrix-wide error
-            pytest.skip(f"postgres backend unavailable: {e}")
+        else:
+            try:
+                s = PostgresTupleStore(dsn, namespace_manager=nsmgr)
+            except Exception as e:
+                # an unreachable EXTERNAL server is a visible skip, not a
+                # matrix-wide error (the in-tree fake leg always runs)
+                pytest.skip(f"external postgres unavailable: {e}")
         yield s
-        from keto_tpu.relationtuple import RelationQuery
+        if not fresh:  # shared external database: leave it clean
+            from keto_tpu.relationtuple import RelationQuery
 
-        s.delete_all_relation_tuples(RelationQuery())
+            s.delete_all_relation_tuples(RelationQuery())
+        s.close()
+        return
+    if request.param == "cockroach":
+        from keto_tpu.persistence.dialect import CockroachDialect
+        from keto_tpu.persistence.sqlstore import SQLTupleStore
+
+        srv = request.getfixturevalue("pgfake_server")
+        s = SQLTupleStore(
+            CockroachDialect(),
+            f"postgres://keto@127.0.0.1:{srv.port}"
+            f"/crdb_{_uuid.uuid4().hex[:12]}",
+            namespace_manager=nsmgr,
+        )
+        yield s
+        s.close()
+        return
+    if request.param == "mysql":
+        from keto_tpu.persistence.dialect import MySQLDialect
+        from keto_tpu.persistence.sqlstore import SQLTupleStore
+
+        external = os.environ.get("KETO_TEST_MYSQL_DSN")
+        dsn = external or f"mysql+fake:///my_{_uuid.uuid4().hex[:12]}"
+        try:
+            s = SQLTupleStore(MySQLDialect(), dsn, namespace_manager=nsmgr)
+        except Exception as e:
+            if external:
+                pytest.skip(f"external mysql unavailable: {e}")
+            raise
+        yield s
+        if external:  # shared external database: leave it clean
+            from keto_tpu.relationtuple import RelationQuery
+
+            s.delete_all_relation_tuples(RelationQuery())
         s.close()
         return
     from keto_tpu.persistence import SQLiteTupleStore

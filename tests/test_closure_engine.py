@@ -284,3 +284,36 @@ class TestInteriorGraph:
         _, eng = make_engines(store)
         assert eng.subject_is_allowed(t("v:/cats/1#view@*"))
         assert not eng.subject_is_allowed(t("v:/cats/2#view@*"))
+
+
+class TestDeviceView:
+    """device_view() serves the same resident closure with
+    query_mode=device — answers must match the host path bit-for-bit
+    (the bench's device leg rests on this parity)."""
+
+    def test_parity_on_random_graphs(self):
+        rng = np.random.default_rng(42)
+        for seed in range(3):
+            store = random_store(np.random.default_rng(seed), 20, 10, 200)
+            oracle, eng = make_engines(store, query_mode="host")
+            dview = eng.device_view()
+            reqs = store.all_tuples()[:64]
+            # mix hits with misses
+            reqs += [t(f"miss:obj{i}#rel@nobody{i}") for i in range(16)]
+            rng.shuffle(reqs)
+            want = eng.batch_check(reqs)
+            got = dview.batch_check(reqs)
+            assert got == want, f"seed {seed}"
+            assert want == oracle.batch_check(reqs), f"seed {seed} vs oracle"
+
+    def test_device_view_requires_resident_closure(self):
+        store = InMemoryTupleStore()
+        # a real interior node (subject-set indirection) with
+        # interior_limit=0 forces the _TooBig fallback state
+        store.write_relation_tuples(
+            t("n:o#r@(n:g#m)"), t("n:g#m@alice")
+        )
+        _, eng = make_engines(store, interior_limit=0)
+        eng.subject_is_allowed(t("n:o#r@alice"))  # forces _TooBig state
+        with pytest.raises(RuntimeError):
+            eng.device_view()
