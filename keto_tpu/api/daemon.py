@@ -171,10 +171,14 @@ def build_read_grpc_server(
     """Read-plane gRPC: Check + Expand + Read + Version + Health +
     reflection, behind the telemetry interceptor chain (reference
     ReadGRPCServer + interceptors, registry_default.go:337-385)."""
+    executor = futures.ThreadPoolExecutor(
+        max_workers=max_workers, thread_name_prefix="keto-grpc-read"
+    )
     server = grpc.server(
-        futures.ThreadPoolExecutor(max_workers=max_workers),
+        executor,
         interceptors=_interceptors("read", logger, metrics, tracer),
     )
+    server._keto_executor = executor  # joined by PlaneServer.stop
     add_check_service(server, CheckServicer(checker, snaptoken_fn))
     add_expand_service(server, ExpandServicer(expand_engine, snaptoken_fn))
     add_read_service(server, ReadServicer(manager))
@@ -190,10 +194,14 @@ def build_write_grpc_server(
 ) -> grpc.Server:
     """Write-plane gRPC: Write + Version + Health + reflection (reference
     WriteGRPCServer, registry_default.go:387-401)."""
+    executor = futures.ThreadPoolExecutor(
+        max_workers=max_workers, thread_name_prefix="keto-grpc-write"
+    )
     server = grpc.server(
-        futures.ThreadPoolExecutor(max_workers=max_workers),
+        executor,
         interceptors=_interceptors("write", logger, metrics, tracer),
     )
+    server._keto_executor = executor  # joined by PlaneServer.stop
     add_write_service(server, WriteServicer(manager, snaptoken_fn))
     add_version_service(server, VersionServicer(version))
     add_health_service(server, health)
@@ -271,6 +279,22 @@ class PlaneServer:
     async def stop(self, grace: float = 2.0) -> None:
         if self._mux is not None:
             await self._mux.stop(grace)
-        self.grpc_server.stop(grace)
+        stopped = self.grpc_server.stop(grace)
         if self._runner is not None:
             await self._runner.cleanup()
+        # Join the handler executor's IDLE threads (a later replica fork's
+        # thread inventory must not see this stopped server's parked
+        # workers as live hazards), but stay bounded: wait=True would
+        # block stop() behind a handler parked in a long engine wait that
+        # grpc abandoned but cannot interrupt. shutdown(wait=False)
+        # signals the idle workers to exit promptly; a busy thread exits
+        # when its handler returns.
+        executor = getattr(self.grpc_server, "_keto_executor", None)
+        if executor is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: (
+                    stopped.wait(grace + 3),
+                    executor.shutdown(wait=False, cancel_futures=True),
+                ),
+            )

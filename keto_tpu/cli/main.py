@@ -44,6 +44,9 @@ def _channel(remote: str) -> grpc.Channel:
     try:
         grpc.channel_ready_future(ch).result(timeout=_CONN_TIMEOUT_S)
     except grpc.FutureTimeoutError:
+        # close before raising: an unclosed channel leaks its
+        # connectivity-poller thread for the process lifetime
+        ch.close()
         raise click.ClickException(
             f"cannot connect to {remote} within {_CONN_TIMEOUT_S}s"
         ) from None

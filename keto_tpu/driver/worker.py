@@ -1,0 +1,76 @@
+"""Spawned read-worker entry: ``python -m keto_tpu.driver.worker``.
+
+Reads the JSON spec from ``KETO_WORKER_SPEC`` (written by
+`spawn_workers.SpawnWorkerPool`), builds its own registry — own database
+connection, own snapshot/engine residency — and serves the read plane on
+the pool's shared SO_REUSEPORT ports. Freshness comes from the engine's
+own ``store.version`` checks against the shared database (the reference's
+stateless-replica model, internal/driver/daemon.go:62-85); no delta
+stream, no fork, no inherited state.
+
+Exits 0 on SIGTERM (the pool's stop), non-zero on boot failure so the
+parent's liveness accounting sees a dead worker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import sys
+
+
+def main() -> int:
+    spec = json.loads(os.environ["KETO_WORKER_SPEC"])
+    from .config import Config
+    from .registry import Registry
+
+    cfg = Config(
+        values=spec["config"],
+        env={},
+        flag_overrides=spec.get("overrides") or {},
+    )
+    reg = Registry(cfg)
+    read_port, grpc_port, http_port = spec["ports"]
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+
+    stop = asyncio.Event()
+    loop.add_signal_handler(signal.SIGTERM, stop.set)
+    loop.add_signal_handler(signal.SIGINT, stop.set)
+
+    async def run() -> int:
+        try:
+            engine = reg.check_engine()
+            if hasattr(engine, "warmup"):
+                max_batch = int(cfg.get("engine.max_batch"))
+                await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: engine.warmup(max_batch)
+                )
+            plane = reg.build_read_plane_shared(
+                read_port, grpc_port, http_port
+            )
+            await plane.start()
+            reg.health.set_serving(True)
+        except BaseException:
+            import traceback
+
+            traceback.print_exc()
+            return 4
+        await stop.wait()
+        try:
+            await plane.stop()
+        except Exception:
+            pass
+        return 0
+
+    try:
+        return loop.run_until_complete(run())
+    finally:
+        loop.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,7 @@ exposes create/check/expand/list/delete and must produce identical
 behavior over the same server."""
 
 import json
+import tempfile
 
 import httpx
 import pytest
@@ -14,7 +15,10 @@ from click.testing import CliRunner
 
 from keto_tpu.cli import cli
 from keto_tpu.client import GrpcClient, RestClient
-from keto_tpu.driver.factory import new_test_registry
+from keto_tpu.driver.factory import (
+    new_sqlite_test_registry,
+    new_test_registry,
+)
 from keto_tpu.relationtuple import RelationQuery, RelationTuple, SubjectSet
 from tests.test_api_server import ServerFixture
 
@@ -23,11 +27,49 @@ def t(s: str) -> RelationTuple:
     return RelationTuple.from_string(s)
 
 
-@pytest.fixture(scope="module")
-def server():
-    s = ServerFixture(new_test_registry(namespaces=("videos",)))
-    yield s
-    s.stop()
+# the reference crosses its one case suite with every DSN
+# (internal/e2e/full_suit_test.go:45-86 x dsn_testutils); here the server
+# axis is {store backend} x {worker pool size} — workers=3 exercises the
+# fork pool (memory/columnar) and the spawn pool (sqlite) end-to-end
+SERVER_CONFIGS = [
+    ("memory", 1),
+    ("memory", 3),
+    ("columnar", 1),
+    ("columnar", 3),
+    ("sqlite", 1),
+    ("sqlite", 3),
+]
+
+
+def _registry_for(store_kind: str, workers: int, tmpdir: str):
+    values = {
+        "serve": {
+            "read": {"port": 0, "host": "127.0.0.1", "workers": workers},
+            "write": {"port": 0, "host": "127.0.0.1"},
+        },
+        "log": {"level": "error"},
+    }
+    if store_kind == "sqlite":
+        return new_sqlite_test_registry(
+            f"{tmpdir}/e2e.db", namespaces=("videos",), values=values
+        )
+    if store_kind == "columnar":
+        values["dsn"] = "columnar"
+        return new_test_registry(namespaces=("videos",), values=values)
+    return new_test_registry(namespaces=("videos",), values=values)
+
+
+@pytest.fixture(
+    scope="module",
+    params=SERVER_CONFIGS,
+    ids=[f"{s}-w{w}" for s, w in SERVER_CONFIGS],
+)
+def server(request):
+    store_kind, workers = request.param
+    with tempfile.TemporaryDirectory() as tmpdir:
+        s = ServerFixture(_registry_for(store_kind, workers, tmpdir))
+        yield s
+        s.stop()
 
 
 class GrpcAdapter:
