@@ -1056,6 +1056,9 @@ def _sharded_child():
                     "tuples": len(store2),
                     "batch": batch,
                     "check_rps_encoded": round(rps),
+                    # wide-fanout handling: escalated device-pass rate and
+                    # the (should-be-~0) host-oracle fallback rate
+                    "overflow_stats": engine.overflow_stats,
                     "per_shard_bytes": per_shard,
                     # straight-line projection of the striped classes to
                     # the 1B rung (D stays fixed — interior doesn't scale

@@ -255,6 +255,8 @@ class ShardedClosureEngine:
         max_depth: int = DEFAULT_MAX_DEPTH,
         f0_max: int = 32,
         l_max: int = 32,
+        f0_max_escalated: int = 512,
+        l_max_escalated: int = 512,
         fallback=None,
     ):
         self.snapshots = snapshots
@@ -262,11 +264,22 @@ class ShardedClosureEngine:
         self.global_max_depth = max_depth
         self.f0_max = f0_max
         self.l_max = l_max
+        # second-pass gather widths for the wide-fanout tail (a user in
+        # hundreds of groups): wide enough that host fallback is a
+        # measurable-rarity, narrow enough that the escalated kernel's
+        # scan stays cheap for the small overflow sub-batches
+        self.f0_max_escalated = f0_max_escalated
+        self.l_max_escalated = l_max_escalated
         self.n_data = self.mesh.shape["data"]
         self.n_edge = self.mesh.shape["edge"]
         self._lock = threading.Lock()
         self._resident = None  # (snap, device arrays..., shard_bytes)
         self._fallback = fallback
+        # overflow accounting: rows seen / escalated to the wide pass /
+        # beyond even that (host oracle) — the bench and dryrun log these
+        self.overflow_stats = {
+            "rows": 0, "escalated": 0, "host_fallback": 0,
+        }
 
     def fallback_engine(self):
         if self._fallback is None:
@@ -397,29 +410,62 @@ class ShardedClosureEngine:
         else:
             want = np.asarray(depths, dtype=np.int32)
             depth[:n] = np.where((want <= 0) | (want > gmax), gmax, want)
-        data_sh = NamedSharding(self.mesh, P("data"))
-        allowed, overflow = _sharded_closure_check(
-            d, f0_ip, f0_v, l_ip, l_v, int_idx, out_ip, out_v,
-            jax.device_put(s, data_sh),
-            jax.device_put(t, data_sh),
-            jax.device_put(flag, data_sh),
-            jax.device_put(depth, data_sh),
-            mesh=self.mesh,
-            n_shards=self.n_edge,
-            m_pad=m_pad,
-            f0_max=self.f0_max,
-            l_max=self.l_max,
-            pn=pn,
+
+        def device_pass(sv, tv, fv, dv, f0_w, l_w):
+            data_sh = NamedSharding(self.mesh, P("data"))
+            a, o = _sharded_closure_check(
+                d, f0_ip, f0_v, l_ip, l_v, int_idx, out_ip, out_v,
+                jax.device_put(sv, data_sh),
+                jax.device_put(tv, data_sh),
+                jax.device_put(fv, data_sh),
+                jax.device_put(dv, data_sh),
+                mesh=self.mesh,
+                n_shards=self.n_edge,
+                m_pad=m_pad,
+                f0_max=f0_w,
+                l_max=l_w,
+                pn=pn,
+            )
+            return np.asarray(a), np.asarray(o)
+
+        allowed, overflow = device_pass(
+            s, t, flag, depth, self.f0_max, self.l_max
         )
-        allowed = np.asarray(allowed)[:n].copy()
-        overflow = np.asarray(overflow)[:n]
+        allowed = allowed[:n].copy()
+        overflow = overflow[:n]
+        self.overflow_stats["rows"] += n
         if overflow.any():
-            # wide fan-out rows: exact host fallback (same contract as the
-            # single-chip engine's width-capped numpy path). Dummy/unknown
-            # endpoints decode to inert empties — the oracle denies them,
-            # matching the clamp semantics.
+            # wide fan-out rows: SECOND device pass at escalated gather
+            # widths (a user in hundreds of groups is ordinary in
+            # team-heavy graphs — VERDICT r4 weak #6; the old host-oracle
+            # funnel made the hot tail single-threaded Python). Only rows
+            # overflowing the escalated widths too fall back to the exact
+            # host oracle, and that rate is tracked for the bench/dryrun.
+            idxs = np.nonzero(overflow)[0]
+            self.overflow_stats["escalated"] += len(idxs)
+            k = len(idxs)
+            b2 = self._bucket_batch(k)
+            s2 = np.full(b2, dummy, dtype=np.int32)
+            t2 = np.full(b2, dummy, dtype=np.int32)
+            flag2 = np.zeros(b2, dtype=bool)
+            depth2 = np.ones(b2, dtype=np.int32)
+            s2[:k], t2[:k] = s[idxs], t[idxs]
+            flag2[:k], depth2[:k] = flag[idxs], depth[idxs]
+            allowed2, overflow2 = device_pass(
+                s2, t2, flag2, depth2,
+                self.f0_max_escalated, self.l_max_escalated,
+            )
+            allowed[idxs] = allowed2[:k]
+            overflow = np.zeros(n, dtype=bool)
+            overflow[idxs[overflow2[:k]]] = True
+        if overflow.any():
+            # beyond even the escalated widths: exact host fallback (same
+            # contract as the single-chip engine's width-capped numpy
+            # path). Dummy/unknown endpoints decode to inert empties —
+            # the oracle denies them, matching the clamp semantics.
             fb = self.fallback_engine()
             idxs = np.nonzero(overflow)[0]
+            self.overflow_stats["host_fallback"] += len(idxs)
             vocab = snap.vocab
             n_live = min(len(vocab), dummy)
             reqs = []
