@@ -113,8 +113,18 @@ CONFIG_SCHEMA = {
             "type": "object",
             "properties": {
                 # "log" mirrors finished spans into the structured log;
-                # "" keeps them only in the in-process ring buffer
-                "provider": {"enum": ["", "log"]}
+                # "otlp" ships OTLP/HTTP JSON batches to
+                # tracing.otlp.endpoint (any OpenTelemetry collector /
+                # Jaeger); "" keeps them only in the in-process buffer
+                "provider": {"enum": ["", "log", "otlp"]},
+                "otlp": {
+                    "type": "object",
+                    "properties": {
+                        "endpoint": {"type": "string"},
+                        "service_name": {"type": "string"},
+                    },
+                    "additionalProperties": False,
+                },
             },
             "additionalProperties": True,
         },
@@ -410,6 +420,13 @@ class Config:
     def _build_namespace_manager(self) -> NamespaceManager:
         spec = self.get(KEY_NAMESPACES)
         if isinstance(spec, str):
+            if spec.startswith("ws://"):
+                # remote config service pushing namespace documents over a
+                # websocket (reference watcherx ws URIs,
+                # namespace_watcher.go:48-89)
+                from ..namespace.watcher import WsNamespaceWatcher
+
+                return WsNamespaceWatcher(spec)
             from ..namespace.watcher import NamespaceWatcher
 
             return NamespaceWatcher(spec)

@@ -77,7 +77,20 @@ class Registry:
             provider = str(
                 self.config.get("tracing.provider", default="") or ""
             )
-            self._tracer = Tracer(provider=provider, logger=self.logger())
+            self._tracer = Tracer(
+                provider=provider,
+                logger=self.logger(),
+                otlp_endpoint=str(
+                    self.config.get("tracing.otlp.endpoint", default="")
+                    or ""
+                ),
+                service_name=str(
+                    self.config.get(
+                        "tracing.otlp.service_name", default="keto-tpu"
+                    )
+                    or "keto-tpu"
+                ),
+            )
         return self._tracer
 
     def metrics(self):
@@ -686,9 +699,26 @@ class Registry:
                             ),
                         )
                     if "tracing" in applied and self._tracer is not None:
-                        self._tracer.provider = str(
-                            self.config.get("tracing.provider", default="")
-                            or ""
+                        self._tracer.reconfigure(
+                            str(
+                                self.config.get(
+                                    "tracing.provider", default=""
+                                )
+                                or ""
+                            ),
+                            otlp_endpoint=str(
+                                self.config.get(
+                                    "tracing.otlp.endpoint", default=""
+                                )
+                                or ""
+                            ),
+                            service_name=str(
+                                self.config.get(
+                                    "tracing.otlp.service_name",
+                                    default="keto-tpu",
+                                )
+                                or "keto-tpu"
+                            ),
                         )
 
         self._config_watcher = threading.Thread(
@@ -727,6 +757,11 @@ class Registry:
             # hang-not-raise mode), same reasoning as PlaneServer.stop
             self._check_executor.shutdown(wait=False, cancel_futures=True)
             self._check_executor = None
+        if self._tracer is not None:
+            # ship the last partial OTLP batch before the process exits
+            self._tracer.flush(timeout_s=3.0)
+            self._tracer.close()
+            self._tracer = None
 
     async def serve_all(self) -> None:
         """Run until cancelled (reference ServeAll, daemon.go:62-69)."""

@@ -43,28 +43,24 @@ class SpawnWorkerPool:
 
     def start(self, read_port: int, grpc_port: int, http_port: int) -> None:
         cfg = self.registry.config
-        worker_values = dict(cfg._data)
-        # workers must not recursively spawn their own pools, and their
-        # read plane binds the parent-resolved shared ports
-        serve = dict(worker_values.get("serve") or {})
-        read = dict(serve.get("read") or {})
-        read["workers"] = 1
-        serve["read"] = read
-        worker_values["serve"] = serve
-        # workers serve host-mode queries on the CPU backend: the parent
-        # (or its accelerator runtime) holds the chip exclusively, so a
-        # worker initializing the TPU backend would fail or hang; the
-        # database-backed datasets a spawn pool serves build their
-        # closures fine on host/CPU. KETO_WORKER_ALLOW_ACCEL=1 opts out
-        # on multi-chip hosts.
-        engine_cfg = dict(worker_values.get("engine") or {})
+        # flag overrides outrank env AND file values in Config.get, so
+        # they pin the worker-critical keys no matter how the operator
+        # set the rest (env-derived settings like KETO_DSN flow through
+        # the worker's own environment untouched):
+        # - workers=1: a worker must not recursively spawn its own pool;
+        # - query_mode=host (unless opted out): the parent/accelerator
+        #   runtime holds the chip exclusively, so a worker initializing
+        #   the TPU backend would fail or hang; database-backed datasets
+        #   a spawn pool serves build their closures fine on host/CPU.
+        #   KETO_WORKER_ALLOW_ACCEL=1 opts out on multi-chip hosts.
         allow_accel = os.environ.get("KETO_WORKER_ALLOW_ACCEL") == "1"
+        overrides = dict(cfg._overrides)
+        overrides["serve.read.workers"] = 1
         if not allow_accel:
-            engine_cfg["query_mode"] = "host"
-            worker_values["engine"] = engine_cfg
+            overrides["engine.query_mode"] = "host"
         spec = {
-            "config": worker_values,
-            "overrides": cfg._overrides,
+            "config": cfg._data,
+            "overrides": overrides,
             "ports": [read_port, grpc_port, http_port],
         }
         if allow_accel:

@@ -26,9 +26,13 @@ def main() -> int:
     from .config import Config
     from .registry import Registry
 
+    # env=os.environ: operator settings provided via KETO_* environment
+    # variables (the DSN, typically) must reach the worker exactly as
+    # they reached the parent; the spec's flag overrides outrank env, so
+    # the worker-critical pins (workers=1, query_mode) still hold
     cfg = Config(
         values=spec["config"],
-        env={},
+        env=dict(os.environ),
         flag_overrides=spec.get("overrides") or {},
     )
     reg = Registry(cfg)

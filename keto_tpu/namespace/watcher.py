@@ -90,6 +90,17 @@ class NamespaceWatcher(NamespaceManager):
         self._stop.set()
         self._thread.join(timeout=5)
 
+    def restart_after_fork(self) -> None:
+        """Forked replicas inherit this object but not its poll thread
+        (fork clones only the calling thread); re-arm the lock and spawn
+        a fresh poller so children keep tracking namespace changes."""
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._watch_loop, name="namespace-watcher", daemon=True
+        )
+        self._thread.start()
+
     # -- loading ---------------------------------------------------------------
 
     def _files(self) -> list[str]:
@@ -136,3 +147,146 @@ class NamespaceWatcher(NamespaceManager):
         while not self._stop.wait(self.poll_interval_s):
             if self._changed():
                 self._load()
+
+
+def parse_namespace_doc(data) -> list[Namespace]:
+    """Namespaces from an already-parsed document (ws:// push payloads):
+    the same shapes `parse_namespace_file` accepts."""
+    if data is None:
+        return []
+    if isinstance(data, dict):
+        if "namespaces" in data and isinstance(data["namespaces"], list):
+            items = data["namespaces"]
+        else:
+            items = [data]
+    elif isinstance(data, list):
+        items = data
+    else:
+        raise ErrMalformedInput("malformed namespace document")
+    out = []
+    for item in items:
+        if not isinstance(item, dict) or "name" not in item:
+            raise ErrMalformedInput(
+                "namespace entries need a 'name' field"
+            )
+        out.append(
+            Namespace(
+                name=item["name"],
+                id=int(item.get("id", 0)),
+                config=item.get("config", {}) or {},
+            )
+        )
+    return out
+
+
+class WsNamespaceWatcher(NamespaceManager):
+    """``ws://`` namespace source: a remote config service pushes namespace
+    documents over a websocket (reference watcherx ws URIs,
+    internal/driver/config/namespace_watcher.go:48-89).
+
+    Each text frame is a JSON namespace document (single object, list, or
+    {"namespaces": [...]}); a malformed frame keeps the last good set
+    (the reference's rollback-to-last-good loop). The reader reconnects
+    with capped exponential backoff — a config-service restart must not
+    take namespace validation down with it."""
+
+    KEEPALIVE_S = 30.0
+
+    def __init__(self, uri: str, connect_timeout_s: float = 10.0):
+        self.uri = uri
+        self.connect_timeout_s = connect_timeout_s
+        self._inner = MemoryNamespaceManager()
+        self._stop = threading.Event()
+        self._conn = None
+        self._connected = threading.Event()
+        self._thread = threading.Thread(
+            target=self._read_loop, name="namespace-ws-watcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- NamespaceManager ------------------------------------------------------
+
+    def get_namespace_by_name(self, name: str) -> Namespace:
+        return self._inner.get_namespace_by_name(name)
+
+    def namespaces(self) -> list[Namespace]:
+        return self._inner.namespaces()
+
+    def should_reload(self, _page_payload=None) -> bool:
+        return True
+
+    def wait_connected(self, timeout_s: float = 10.0) -> bool:
+        """Block until the first successful connect (boot/test sync)."""
+        return self._connected.wait(timeout_s)
+
+    def restart_after_fork(self) -> None:
+        """Forked replicas inherit this object but not its reader thread;
+        reconnect with a fresh socket (the parent's connection belongs to
+        the parent — reading it from two processes would interleave
+        frames)."""
+        self._conn = None
+        self._stop = threading.Event()
+        self._connected = threading.Event()
+        self._thread = threading.Thread(
+            target=self._read_loop, name="namespace-ws-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        conn = self._conn
+        if conn is not None:
+            try:
+                conn.close()  # unblocks the reader
+            except OSError:
+                pass
+        self._thread.join(timeout=5)
+
+    # -- reader ----------------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        import json
+
+        from ..utils import ws
+
+        backoff = 0.2
+        while not self._stop.is_set():
+            try:
+                conn = ws.connect(self.uri, timeout=self.connect_timeout_s)
+            except (OSError, ws.WSError):
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 10.0)
+                continue
+            self._conn = conn
+            self._connected.set()
+            backoff = 0.2
+            try:
+                while not self._stop.is_set():
+                    try:
+                        text = conn.recv_text(timeout=self.KEEPALIVE_S)
+                    except TimeoutError:
+                        # idle: probe the peer; a half-open connection
+                        # (peer died without FIN) must reconnect, not
+                        # stall namespace updates forever
+                        conn.ping()
+                        continue
+                    if text is None:
+                        break  # clean close: reconnect
+                    try:
+                        self._inner.replace_all(
+                            parse_namespace_doc(json.loads(text))
+                        )
+                    except Exception:
+                        # ANY malformed frame (bad JSON, bad types, null
+                        # ids) keeps the last good set; a parse error
+                        # must never kill the reader thread
+                        pass
+            except (OSError, ws.WSError):
+                pass
+            finally:
+                self._conn = None
+                try:
+                    conn.close()
+                except OSError:
+                    pass

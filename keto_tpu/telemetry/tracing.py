@@ -1,12 +1,17 @@
 """Tracing: lightweight spans over engine phases and requests.
 
-The reference attaches OpenTracing middleware/interceptors everywhere
-(reference internal/driver/registry_default.go:289-291,344-346,360-362 and
-config `tracing.*`, provider.go:178-188). The runtime image has no OTLP
-exporter, so spans here export two ways:
+The reference attaches OpenTracing middleware/interceptors everywhere and
+wires them to a real collector (reference
+internal/driver/registry_default.go:289-291,344-346,360-362, config
+`tracing.*` provider.go:178-188, docker-compose-tracing.yml). Spans here
+export three ways:
 
 - to the structured log (``tracing.provider: log``) — one line per span
   with name, duration, parentage, and attributes;
+- over the wire (``tracing.provider: otlp`` + ``tracing.otlp.endpoint``)
+  — OTLP/HTTP JSON batches POSTed to ``<endpoint>/v1/traces`` from a
+  background flusher (stdlib urllib; no new deps), the encoding every
+  OpenTelemetry collector/Jaeger ingests natively;
 - always to a bounded in-process ring buffer, which tests and debug
   endpoints can read back.
 
@@ -67,16 +72,28 @@ class Span:
 
 class Tracer:
     """Factory + exporter for spans. ``provider``: "log" mirrors every
-    finished span into the structured log; anything else keeps spans only
-    in the ring buffer."""
+    finished span into the structured log; "otlp" also ships batches to
+    ``otlp_endpoint``; anything else keeps spans only in the ring
+    buffer."""
 
     def __init__(
-        self, provider: str = "", logger=None, buffer_size: int = 2048
+        self,
+        provider: str = "",
+        logger=None,
+        buffer_size: int = 2048,
+        otlp_endpoint: str = "",
+        service_name: str = "keto-tpu",
+        flush_interval_s: float = 2.0,
     ):
         self.provider = provider
         self._logger = logger
         self._lock = threading.Lock()
         self._finished: deque[Span] = deque(maxlen=buffer_size)
+        self._otlp = None
+        if provider == "otlp" and otlp_endpoint:
+            self._otlp = _OtlpExporter(
+                otlp_endpoint, service_name, flush_interval_s
+            )
 
     def span(self, name: str, **attrs) -> Span:
         return Span(self, name, attrs)
@@ -93,6 +110,8 @@ class Tracer:
                 ms=round(1000 * span.duration, 3),
                 **span.attrs,
             )
+        if self._otlp is not None:
+            self._otlp.enqueue(span)
 
     def finished(self, name: Optional[str] = None) -> list[Span]:
         with self._lock:
@@ -100,6 +119,178 @@ class Tracer:
         if name is not None:
             spans = [s for s in spans if s.name == name]
         return spans
+
+    def flush(self, timeout_s: float = 5.0) -> None:
+        """Push any queued OTLP batch now (shutdown/test sync)."""
+        if self._otlp is not None:
+            self._otlp.flush(timeout_s)
+
+    def close(self) -> None:
+        if self._otlp is not None:
+            self._otlp.close()
+            self._otlp = None
+
+    def reconfigure(
+        self,
+        provider: str,
+        otlp_endpoint: str = "",
+        service_name: str = "keto-tpu",
+        flush_interval_s: float = 2.0,
+    ) -> None:
+        """Apply a config hot-reload: swap the provider AND rebuild the
+        wire exporter to match (assigning ``provider`` alone would leave
+        an old exporter shipping, or a new one never created)."""
+        old = self._otlp
+        self.provider = provider
+        if provider == "otlp" and otlp_endpoint:
+            if old is None or old.url != (
+                otlp_endpoint.rstrip("/") + "/v1/traces"
+            ):
+                self._otlp = _OtlpExporter(
+                    otlp_endpoint, service_name, flush_interval_s
+                )
+                if old is not None:
+                    old.close()
+        else:
+            self._otlp = None
+            if old is not None:
+                old.close()
+
+
+class _OtlpExporter:
+    """Background OTLP/HTTP JSON trace exporter (stdlib only).
+
+    Spans queue in a bounded deque; a flusher thread POSTs batches to
+    ``<endpoint>/v1/traces`` in the OTLP JSON encoding (hex trace/span
+    ids, unix-nano timestamps, stringified attributes). Export failures
+    drop the batch after logging once per streak — tracing must never
+    wedge the serving path."""
+
+    MAX_QUEUE = 8192
+    MAX_BATCH = 512
+
+    def __init__(self, endpoint: str, service_name: str, interval_s: float):
+        self.url = endpoint.rstrip("/") + "/v1/traces"
+        self.service_name = service_name
+        self.interval_s = interval_s
+        self._q: deque[Span] = deque(maxlen=self.MAX_QUEUE)
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._warned = False
+        self._thread = threading.Thread(
+            target=self._run, name="otlp-exporter", daemon=True
+        )
+        self._thread.start()
+
+    def enqueue(self, span: Span) -> None:
+        self._q.append(span)
+        self._idle.clear()
+
+    def flush(self, timeout_s: float) -> None:
+        self._wake.set()
+        self._idle.wait(timeout_s)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while True:
+            self._wake.wait(timeout=self.interval_s)
+            self._wake.clear()
+            while self._q:
+                batch = []
+                while self._q and len(batch) < self.MAX_BATCH:
+                    batch.append(self._q.popleft())
+                self._post(batch)
+            self._idle.set()
+            if self._stop.is_set() and not self._q:
+                return
+
+    def _post(self, batch: list[Span]) -> None:
+        import json
+        import urllib.error
+        import urllib.request
+
+        body = json.dumps(self._encode(batch)).encode()
+        req = urllib.request.Request(
+            self.url,
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                resp.read()
+            self._warned = False
+        except Exception:
+            # ANY export failure (refused, timeout, malformed collector
+            # response raising HTTPException, ...) drops the batch — an
+            # exception escaping here would kill the exporter thread and
+            # wedge every future flush()
+            if not self._warned:
+                self._warned = True
+                import logging
+
+                logging.getLogger("keto.telemetry").warning(
+                    "OTLP trace export to %s failing; dropping batches "
+                    "until it recovers",
+                    self.url,
+                )
+
+    def _encode(self, batch: list[Span]) -> dict:
+        def attr(k, v):
+            return {"key": str(k), "value": {"stringValue": str(v)}}
+
+        return {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            attr("service.name", self.service_name)
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "keto_tpu"},
+                            "spans": [
+                                {
+                                    "traceId": f"{s.trace_id:032x}",
+                                    "spanId": f"{s.span_id:016x}",
+                                    **(
+                                        {
+                                            "parentSpanId":
+                                                f"{s.parent_id:016x}"
+                                        }
+                                        if s.parent_id
+                                        else {}
+                                    ),
+                                    "name": s.name,
+                                    "kind": 1,  # SPAN_KIND_INTERNAL
+                                    "startTimeUnixNano": str(
+                                        int(s.start * 1e9)
+                                    ),
+                                    "endTimeUnixNano": str(
+                                        int(
+                                            (s.start + (s.duration or 0))
+                                            * 1e9
+                                        )
+                                    ),
+                                    "attributes": [
+                                        attr(k, v)
+                                        for k, v in s.attrs.items()
+                                    ],
+                                }
+                                for s in batch
+                            ],
+                        }
+                    ],
+                }
+            ]
+        }
 
 
 NOOP_TRACER = Tracer()

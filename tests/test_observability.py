@@ -242,3 +242,91 @@ class TestMetricsPrimitives:
         assert doc["msg"] == "hello" and doc["a"] == 1 and doc["b"] == "x"
         # restore default so later tests aren't json-formatted
         configure_logging(level="info", format="text")
+
+
+class TestOtlpExport:
+    """tracing.provider=otlp ships OTLP/HTTP JSON batches to a collector
+    (the reference wires opentracing to a real collector end-to-end,
+    registry_default.go:118-129 + docker-compose-tracing.yml; here a
+    local fake collector receives the standard encoding)."""
+
+    def test_spans_land_in_local_collector(self):
+        import json
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        from keto_tpu.telemetry.tracing import Tracer
+
+        received = []
+        got_one = threading.Event()
+
+        class Collector(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(n))
+                received.append((self.path, doc))
+                got_one.set()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        httpd = HTTPServer(("127.0.0.1", 0), Collector)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        tracer = Tracer(
+            provider="otlp",
+            otlp_endpoint=f"http://127.0.0.1:{httpd.server_port}",
+            service_name="keto-test",
+            flush_interval_s=0.1,
+        )
+        try:
+            with tracer.span("parent", kind="outer") as parent:
+                with tracer.span("child", edges=42):
+                    pass
+            tracer.flush(10)
+            assert got_one.wait(10)
+            path, doc = received[0]
+            assert path == "/v1/traces"
+            rs = doc["resourceSpans"][0]
+            svc = {
+                a["key"]: a["value"]["stringValue"]
+                for a in rs["resource"]["attributes"]
+            }
+            assert svc["service.name"] == "keto-test"
+            spans = {
+                s["name"]: s for s in rs["scopeSpans"][0]["spans"]
+            }
+            assert set(spans) == {"parent", "child"}
+            child = spans["child"]
+            assert child["parentSpanId"] == spans["parent"]["spanId"]
+            assert child["traceId"] == spans["parent"]["traceId"]
+            attrs = {
+                a["key"]: a["value"]["stringValue"]
+                for a in child["attributes"]
+            }
+            assert attrs["edges"] == "42"
+            assert int(child["endTimeUnixNano"]) >= int(
+                child["startTimeUnixNano"]
+            )
+        finally:
+            tracer.close()
+            httpd.shutdown()
+
+    def test_collector_outage_never_blocks_spans(self):
+        from keto_tpu.telemetry.tracing import Tracer
+
+        tracer = Tracer(
+            provider="otlp",
+            otlp_endpoint="http://127.0.0.1:1",  # nothing listens
+            flush_interval_s=0.05,
+        )
+        try:
+            for _ in range(50):
+                with tracer.span("work"):
+                    pass
+            tracer.flush(10)  # must return despite the dead endpoint
+            assert len(tracer.finished("work")) == 50
+        finally:
+            tracer.close()
