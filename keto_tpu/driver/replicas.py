@@ -125,6 +125,17 @@ def _reset_inherited_locks(registry) -> None:
     inner = getattr(nsmgr, "inner", None)
     if inner is not None and hasattr(inner, "restart_after_fork"):
         inner.restart_after_fork()
+    # the OTLP exporter's flusher thread is gone too: rebuild it so
+    # replica-served spans (most of the traffic) still reach the
+    # collector instead of piling into a dead queue
+    tracer = registry._tracer
+    if tracer is not None and tracer._otlp is not None:
+        old = tracer._otlp
+        from ..telemetry.tracing import _OtlpExporter
+
+        tracer._otlp = _OtlpExporter(
+            old.url[: -len("/v1/traces")], old.service_name, old.interval_s
+        )
 
 
 class ReplicaPool:

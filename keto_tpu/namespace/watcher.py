@@ -224,6 +224,16 @@ class WsNamespaceWatcher(NamespaceManager):
         reconnect with a fresh socket (the parent's connection belongs to
         the parent — reading it from two processes would interleave
         frames)."""
+        conn = self._conn
+        if conn is not None:
+            try:
+                # drop the INHERITED fd copy without websocket close
+                # semantics: a close frame would tear down the parent's
+                # live connection, but the raw fd must not leak into
+                # every child for its lifetime
+                conn._sock.close()
+            except OSError:
+                pass
         self._conn = None
         self._stop = threading.Event()
         self._connected = threading.Event()

@@ -24,7 +24,7 @@ explicit plumbing.
 from __future__ import annotations
 
 import contextvars
-import itertools
+import os as _os
 import threading
 import time
 from collections import deque
@@ -34,7 +34,26 @@ _current_span: contextvars.ContextVar[Optional["Span"]] = (
     contextvars.ContextVar("keto_tpu_span", default=None)
 )
 
-_ids = itertools.count(1)
+
+def _new_trace_id() -> int:
+    """Random 128-bit trace id (W3C/OTLP convention). Sequential
+    per-process counters collide across processes — spawn workers and
+    forked replicas sharing one collector would merge unrelated spans
+    into the same traces."""
+    return int.from_bytes(_os.urandom(16), "big") or 1
+
+
+def _new_span_id() -> int:
+    return int.from_bytes(_os.urandom(8), "big") or 1
+
+
+def _warn_missing_endpoint() -> None:
+    import logging
+
+    logging.getLogger("keto.telemetry").warning(
+        "tracing.provider is 'otlp' but tracing.otlp.endpoint is unset: "
+        "spans stay in-process only (set the endpoint to export)"
+    )
 
 
 class Span:
@@ -48,8 +67,8 @@ class Span:
         self.attrs = attrs
         parent = _current_span.get()
         self.parent_id = parent.span_id if parent else None
-        self.trace_id = parent.trace_id if parent else next(_ids)
-        self.span_id = next(_ids)
+        self.trace_id = parent.trace_id if parent else _new_trace_id()
+        self.span_id = _new_span_id()
         self.start = time.time()
         self.duration = None
         self._tracer = tracer
@@ -94,6 +113,8 @@ class Tracer:
             self._otlp = _OtlpExporter(
                 otlp_endpoint, service_name, flush_interval_s
             )
+        elif provider == "otlp":
+            _warn_missing_endpoint()
 
     def span(self, name: str, **attrs) -> Span:
         return Span(self, name, attrs)
@@ -143,8 +164,10 @@ class Tracer:
         old = self._otlp
         self.provider = provider
         if provider == "otlp" and otlp_endpoint:
-            if old is None or old.url != (
-                otlp_endpoint.rstrip("/") + "/v1/traces"
+            if (
+                old is None
+                or old.url != otlp_endpoint.rstrip("/") + "/v1/traces"
+                or old.service_name != service_name
             ):
                 self._otlp = _OtlpExporter(
                     otlp_endpoint, service_name, flush_interval_s
@@ -152,6 +175,8 @@ class Tracer:
                 if old is not None:
                     old.close()
         else:
+            if provider == "otlp":
+                _warn_missing_endpoint()
             self._otlp = None
             if old is not None:
                 old.close()
@@ -207,7 +232,12 @@ class _OtlpExporter:
                     batch.append(self._q.popleft())
                 self._post(batch)
             self._idle.set()
-            if self._stop.is_set() and not self._q:
+            if self._q:
+                # an enqueue raced the drain/_idle.set window: a flush()
+                # waiter must not observe idle with work pending
+                self._idle.clear()
+                continue
+            if self._stop.is_set():
                 return
 
     def _post(self, batch: list[Span]) -> None:

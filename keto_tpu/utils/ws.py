@@ -66,32 +66,53 @@ class WSConn:
         self._client = client
         self._buf = bytearray(leftover)
 
-    def _recv_exact(self, n: int) -> bytes:
-        while len(self._buf) < n:
-            chunk = self._sock.recv(65536)
-            if not chunk:
-                raise WSError("peer closed")
-            self._buf += chunk
-        out = bytes(self._buf[:n])
-        del self._buf[:n]
-        return out
-
-    def _read_frame(self) -> tuple[int, bytes]:
-        b0, b1 = self._recv_exact(2)
-        opcode = b0 & 0x0F
-        masked = bool(b1 & 0x80)
-        n = b1 & 0x7F
+    def _try_parse(self):
+        """Parse one complete frame from the buffer WITHOUT consuming a
+        partial one — a recv timeout mid-frame must leave the stream
+        resumable at the same byte offset, or the next read desyncs into
+        payload bytes parsed as headers."""
+        buf = self._buf
+        if len(buf) < 2:
+            return None
+        opcode = buf[0] & 0x0F
+        masked = bool(buf[1] & 0x80)
+        n = buf[1] & 0x7F
+        pos = 2
         if n == 126:
-            (n,) = struct.unpack("!H", self._recv_exact(2))
+            if len(buf) < 4:
+                return None
+            (n,) = struct.unpack("!H", buf[2:4])
+            pos = 4
         elif n == 127:
-            (n,) = struct.unpack("!Q", self._recv_exact(8))
-        key = self._recv_exact(4) if masked else None
-        payload = self._recv_exact(n) if n else b""
+            if len(buf) < 10:
+                return None
+            (n,) = struct.unpack("!Q", buf[2:10])
+            pos = 10
+        key = None
+        if masked:
+            if len(buf) < pos + 4:
+                return None
+            key = bytes(buf[pos : pos + 4])
+            pos += 4
+        if len(buf) < pos + n:
+            return None
+        payload = bytes(buf[pos : pos + n])
+        del buf[: pos + n]
         if key:
             payload = bytes(
                 b ^ key[i % 4] for i, b in enumerate(payload)
             )
         return opcode, payload
+
+    def _read_frame(self) -> tuple[int, bytes]:
+        while True:
+            frame = self._try_parse()
+            if frame is not None:
+                return frame
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise WSError("peer closed")
+            self._buf += chunk
 
     def send_text(self, text: str) -> None:
         self._sock.sendall(

@@ -167,7 +167,7 @@ class TestTls:
             # HTTPS REST through the TLS mux
             r = httpx.get(
                 f"https://127.0.0.1:{s.read_port}/health/alive",
-                verify=str(cert),
+                verify=ssl.create_default_context(cafile=str(cert)),
             )
             assert r.status_code == 200
             # plaintext against the TLS port fails

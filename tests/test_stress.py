@@ -170,3 +170,70 @@ def test_store_isolation_under_concurrent_tenants():
     assert all(x.object.startswith("a") for x in a_tuples)
     assert rega.check_engine().subject_is_allowed(t("videos:a1#r@u1"))
     assert not rega.check_engine().subject_is_allowed(t("videos:b1#r@u1"))
+
+
+def test_interior_churn_under_concurrent_checkers():
+    """r5: concurrent interior-edge inserts AND deletes (the overlay's
+    re-close path) racing a checker pool — answers must converge to the
+    oracle with zero wrong-version crashes and no overlay corruption."""
+    import numpy as np
+
+    from keto_tpu.engine.closure import ClosureCheckEngine
+    from keto_tpu.graph import SnapshotManager
+    from keto_tpu.store import InMemoryTupleStore
+
+    store = InMemoryTupleStore()
+    n_groups = 10
+    base = []
+    for g in range(n_groups):
+        base.append(t(f"n:g{g}#m@u{g % 4}"))
+        base.append(t(f"n:doc{g % 3}#view@(n:g{g}#m)"))
+    for i in range(6):
+        base.append(t(f"n:g{i}#m@(n:g{i + 2}#m)"))
+    store.write_relation_tuples(*base)
+    engine = ClosureCheckEngine(
+        SnapshotManager(store), max_depth=5, rebuild_debounce_s=0.0
+    )
+    reqs = [t(f"n:doc{d}#view@u{u}") for d in range(3) for u in range(4)]
+    engine.batch_check(reqs)
+
+    stop = threading.Event()
+    errors: list = []
+
+    def writer(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                a, b = (int(x) for x in rng.integers(n_groups, size=2))
+                edge = t(f"n:g{a}#m@(n:g{b}#m)")
+                if rng.random() < 0.5:
+                    store.write_relation_tuples(edge)
+                else:
+                    store.delete_relation_tuples(edge)
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    def checker():
+        try:
+            while not stop.is_set():
+                engine.batch_check(reqs)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(s,), daemon=True)
+        for s in range(3)
+    ] + [threading.Thread(target=checker, daemon=True) for _ in range(2)]
+    for th in threads:
+        th.start()
+    time.sleep(2.5)
+    stop.set()
+    for th in threads:
+        th.join(timeout=30)
+        assert not th.is_alive(), "stress thread wedged"
+    assert not errors, errors
+
+    # convergence: quiesced answers equal the oracle at the live version
+    engine.wait_for_version(store.version, timeout_s=60)
+    oracle = CheckEngine(store, max_depth=5)
+    assert engine.batch_check(reqs) == oracle.batch_check(reqs)
