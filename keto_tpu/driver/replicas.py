@@ -128,14 +128,8 @@ def _reset_inherited_locks(registry) -> None:
     # the OTLP exporter's flusher thread is gone too: rebuild it so
     # replica-served spans (most of the traffic) still reach the
     # collector instead of piling into a dead queue
-    tracer = registry._tracer
-    if tracer is not None and tracer._otlp is not None:
-        old = tracer._otlp
-        from ..telemetry.tracing import _OtlpExporter
-
-        tracer._otlp = _OtlpExporter(
-            old.url[: -len("/v1/traces")], old.service_name, old.interval_s
-        )
+    if registry._tracer is not None:
+        registry._tracer.restart_after_fork()
 
 
 class ReplicaPool:

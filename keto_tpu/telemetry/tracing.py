@@ -151,6 +151,16 @@ class Tracer:
             self._otlp.close()
             self._otlp = None
 
+    def restart_after_fork(self) -> None:
+        """Forked replicas inherit this tracer but not the exporter's
+        flusher thread; rebuild the exporter from its own recorded
+        configuration so replica-served spans still reach the collector."""
+        old = self._otlp
+        if old is not None:
+            self._otlp = _OtlpExporter(
+                old.endpoint, old.service_name, old.interval_s
+            )
+
     def reconfigure(
         self,
         provider: str,
@@ -195,6 +205,7 @@ class _OtlpExporter:
     MAX_BATCH = 512
 
     def __init__(self, endpoint: str, service_name: str, interval_s: float):
+        self.endpoint = endpoint
         self.url = endpoint.rstrip("/") + "/v1/traces"
         self.service_name = service_name
         self.interval_s = interval_s
