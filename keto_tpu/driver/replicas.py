@@ -114,6 +114,11 @@ def _reset_inherited_locks(registry) -> None:
     ov = getattr(engine, "_overlay", None)
     if ov is not None:
         ov._lock = th.Lock()
+        ov._groupings_build_lock = th.Lock()
+        # the parent's warm thread (if any) didn't survive the fork;
+        # re-kick it so a child's first interior delete doesn't pay the
+        # O(E log E) build inside its drain
+        ov.warm_groupings_async()
     if hasattr(engine, "allow_device_builds"):
         # jax is fork-unsafe: a replica that outgrows its overlay falls
         # back to the live-store oracle instead of a device rebuild
@@ -250,6 +255,9 @@ class ReplicaPool:
         "namespace-ws-watcher",
         "otlp-exporter",
         "config-watcher",
+        # transient pure-compute warm of the overlay's sorted edge
+        # groupings; its build lock is re-armed post-fork
+        "overlay-groupings-warm",
     )
 
     def _enforce_fork_inventory(self) -> None:
