@@ -52,7 +52,15 @@ ROUTE_EXPAND = "/expand"
 
 
 def _json_error(err: KetoError) -> web.Response:
-    return web.json_response(err.envelope(), status=err.status_code)
+    headers = {}
+    retry_after = getattr(err, "retry_after_s", None)
+    if retry_after is not None or err.status_code in (429, 503):
+        # load shed / transient unavailability: invite the retry-with-
+        # backoff the client SDK implements
+        headers["Retry-After"] = str(int(retry_after or 1))
+    return web.json_response(
+        err.envelope(), status=err.status_code, headers=headers
+    )
 
 
 @web.middleware

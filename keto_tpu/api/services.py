@@ -49,6 +49,13 @@ _PKG = "ory.keto.acl.v1alpha1"
 def _abort(context: grpc.ServicerContext, err: Exception):
     if isinstance(err, KetoError):
         code = getattr(grpc.StatusCode, err.grpc_code, grpc.StatusCode.INTERNAL)
+        retry_after = getattr(err, "retry_after_s", None)
+        if retry_after is not None:
+            # the gRPC spelling of Retry-After: a trailing-metadata hint
+            # for shed requests (RESOURCE_EXHAUSTED)
+            context.set_trailing_metadata(
+                (("retry-after", str(int(retry_after))),)
+            )
         context.abort(code, err.message)
     context.abort(grpc.StatusCode.INTERNAL, str(err))
 

@@ -1,0 +1,107 @@
+"""Client-side retry: exponential backoff with jitter, deadline-honoring.
+
+The server now sheds load (HTTP 429 / gRPC RESOURCE_EXHAUSTED when the
+check queue is full) and may be briefly UNAVAILABLE around replica
+restarts — both are explicit invitations to retry, and a client that
+retries immediately just re-arrives in the same overloaded instant as
+every other rejected caller. The policy here is the standard remedy:
+exponential backoff with randomized jitter to decorrelate retry storms,
+and a hard overall deadline so retrying never takes longer than the
+caller was willing to wait for the original call.
+
+Deadline accounting is end-to-end: each attempt is given the REMAINING
+budget as its per-attempt timeout, and a backoff sleep that would
+overshoot the deadline is not taken — the last error is raised instead.
+
+``sleep`` and ``rand`` are injectable so tests drive the schedule
+deterministically.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional
+
+#: gRPC status codes worth retrying: the server was unreachable/restarting,
+#: or explicitly shed this request before doing any work.
+RETRYABLE_GRPC_CODES = ("UNAVAILABLE", "RESOURCE_EXHAUSTED")
+#: The HTTP equivalents (api/rest.py maps the same error taxonomy).
+RETRYABLE_HTTP_STATUS = (429, 503)
+
+
+class RetryPolicy:
+    """Backoff schedule: ``base * multiplier**attempt`` capped at ``max_delay``,
+    scaled by ``1 - jitter + jitter*rand()`` (jitter=0.5 -> 50-100% of the
+    nominal delay). ``max_attempts`` counts the first try."""
+
+    def __init__(
+        self,
+        max_attempts: int = 4,
+        base_delay_s: float = 0.05,
+        multiplier: float = 2.0,
+        max_delay_s: float = 2.0,
+        jitter: float = 0.5,
+        sleep: Callable[[float], None] = time.sleep,
+        rand: Callable[[], float] = random.random,
+    ):
+        self.max_attempts = max(1, max_attempts)
+        self.base_delay_s = base_delay_s
+        self.multiplier = multiplier
+        self.max_delay_s = max_delay_s
+        self.jitter = min(1.0, max(0.0, jitter))
+        self.sleep = sleep
+        self.rand = rand
+
+    def delay_s(self, attempt: int) -> float:
+        nominal = min(
+            self.max_delay_s, self.base_delay_s * self.multiplier**attempt
+        )
+        return nominal * (1.0 - self.jitter + self.jitter * self.rand())
+
+
+def run_with_retry(
+    attempt_fn: Callable[[Optional[float]], object],
+    policy: RetryPolicy,
+    retryable: Callable[[BaseException], bool],
+    timeout: Optional[float] = None,
+    clock: Callable[[], float] = time.monotonic,
+):
+    """Run ``attempt_fn(remaining_s)`` until it succeeds, raises a
+    non-retryable error, exhausts ``policy.max_attempts``, or the overall
+    ``timeout`` leaves no room for another attempt."""
+    deadline = None if timeout is None else clock() + timeout
+    attempt = 0
+    while True:
+        remaining = None if deadline is None else deadline - clock()
+        if remaining is not None and remaining <= 0:
+            remaining = 0.0  # let the transport raise its own deadline error
+        try:
+            return attempt_fn(remaining)
+        except BaseException as e:
+            if attempt + 1 >= policy.max_attempts or not retryable(e):
+                raise
+            delay = policy.delay_s(attempt)
+            if deadline is not None and clock() + delay >= deadline:
+                # sleeping would eat the whole remaining budget: the caller
+                # is better served by the real error now than by a
+                # guaranteed deadline failure later
+                raise
+            policy.sleep(delay)
+            attempt += 1
+
+
+def grpc_code_name(err: BaseException) -> str:
+    """The status-code NAME of a grpc.RpcError ('' when unavailable) —
+    structural, so tests can use lightweight fakes."""
+    code = getattr(err, "code", None)
+    if not callable(code):
+        return ""
+    try:
+        return getattr(code(), "name", "") or ""
+    except Exception:
+        return ""
+
+
+def grpc_retryable(err: BaseException) -> bool:
+    return grpc_code_name(err) in RETRYABLE_GRPC_CODES

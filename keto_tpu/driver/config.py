@@ -174,6 +174,13 @@ CONFIG_SCHEMA = {
                 "cache_size": {"type": "integer", "minimum": 0},
                 "strong_freshness_edges": {"type": "integer", "minimum": 0},
                 "rebuild_debounce_ms": {"type": "number", "minimum": 0},
+                # dispatch queue bound before the batcher sheds load with
+                # 429/RESOURCE_EXHAUSTED (0 = 8 * max_batch)
+                "max_queue": {"type": "integer", "minimum": 0},
+                # device-engine circuit breaker -> host-oracle fallback
+                "fallback": {"type": "boolean"},
+                "fallback_threshold": {"type": "integer", "minimum": 1},
+                "fallback_cooldown_ms": {"type": "number", "minimum": 0},
                 "mesh": {
                     "type": "object",
                     "properties": {
@@ -212,6 +219,10 @@ DEFAULTS = {
     "engine.strong_freshness_edges": 1 << 21,
     "engine.rebuild_debounce_ms": 50,
     "engine.cache_size": 65536,
+    "engine.max_queue": 0,
+    "engine.fallback": True,
+    "engine.fallback_threshold": 3,
+    "engine.fallback_cooldown_ms": 1000,
     "engine.mesh.data": 1,
     "engine.mesh.edge": 0,
 }

@@ -42,6 +42,7 @@ class Registry:
         self._expand_engine = None
         self._batcher = None
         self._checker = None
+        self._engine_breaker = None
         self.health = HealthServicer()
         self.version = __version__
         self._read_plane: Optional[PlaneServer] = None
@@ -284,6 +285,29 @@ class Registry:
                     cache = CheckResultCache(
                         capacity=cache_size, metrics=self.metrics()
                     )
+                # the breaker wraps the engine at THIS seam only: the rest
+                # of the registry (fork inventory, host_queries gating,
+                # staleness gauges) keeps seeing the raw engine
+                if bool(self.config.get("engine.fallback", default=True)):
+                    from ..engine.fallback import DeviceFallbackEngine
+
+                    max_depth = self.config.read_api_max_depth()
+                    engine = self._engine_breaker = DeviceFallbackEngine(
+                        engine,
+                        fallback_factory=lambda: CheckEngine(
+                            self.store(), max_depth=max_depth
+                        ),
+                        failure_threshold=int(
+                            self.config.get("engine.fallback_threshold")
+                        ),
+                        cooldown_s=float(
+                            self.config.get("engine.fallback_cooldown_ms")
+                        )
+                        / 1e3,
+                        health=self.health,
+                        metrics=self.metrics(),
+                        logger=self.logger(),
+                    )
                 self._batcher = CheckBatcher(
                     engine,
                     max_batch=int(self.config.get("engine.max_batch")),
@@ -292,6 +316,10 @@ class Registry:
                     metrics=self.metrics(),
                     cache=cache,
                     version_fn=self._answering_version,
+                    max_queue=int(
+                        self.config.get("engine.max_queue", default=0)
+                    ),
+                    logger=self.logger(),
                 )
                 self._checker = self._batcher
         return self._checker

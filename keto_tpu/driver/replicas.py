@@ -1,4 +1,5 @@
-"""Read-replica worker pool: fork-shared residency, SO_REUSEPORT serving.
+"""Read-replica worker pool: fork-shared residency, SO_REUSEPORT serving,
+parent-side supervision with delta-stream resync.
 
 One Python process caps the RPC surface far below what the engine delivers
 (VERDICT r3 weak #4: the engine answers ~800k checks/s while one process's
@@ -29,16 +30,44 @@ exists in the parent (grpc's C core is not fork-safe once started), and at
 a quiesced moment (warmup done, no in-flight writes). Bulk store loads
 after the pool starts are not supported (the delta stream cannot describe
 them); the serve path never does that.
+
+Self-healing (the parts the fault matrix in tests/test_faults.py drives):
+
+- **Supervision.** A parent-side supervisor thread select()s on every
+  replica's delta socket; EOF means the replica died (SIGKILL, OOM, the
+  armed ``replica.crash`` fault). The dead replica is pruned and a
+  replacement is requested — capacity heals instead of silently decaying
+  to a single process.
+- **Zygote respawn.** The parent cannot fork once its gRPC server exists,
+  so a non-serving ZYGOTE process is forked first, before any server. It
+  holds the shared residency, keeps its store fresh by applying the same
+  delta stream single-threaded, and forks replacement replicas on demand
+  — each respawn inherits near-current state for the cost of a fork, not
+  a rebuild. Spawn commands ship the replica's delta socket by fd-passing
+  (``socket.send_fds``) and the parent's CURRENT fault-registry snapshot,
+  so a fault disarmed in the parent does not resurrect in respawns.
+- **Resync handshake.** A replica announces its store version on boot
+  (``("resync", v)``) and again the moment it observes a version gap
+  (e.g. the armed ``delta.drop`` fault, or a respawned replica whose
+  zygote-inherited state lags the live stream). The parent replays the
+  missing frames from a bounded in-memory delta log; a gap older than the
+  log gets ``("restart",)`` — the replica exits and is respawned fresh
+  from the near-current zygote. Staleness is bounded by supervision, not
+  by luck.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+import select
 import socket
 import struct
 import threading
+from collections import deque
 from typing import Optional
+
+from ..faults import FAULTS
 
 _LEN = struct.Struct("!I")
 
@@ -88,10 +117,15 @@ def resolve_free_ports(specs: list[tuple[str, int]]) -> list[int]:
     return out
 
 
-def _reset_inherited_locks(registry) -> None:
+def _reset_inherited_locks(registry, serving: bool = True) -> None:
     """Fresh synchronization primitives for a forked replica. The fork
     happens quiesced so no lock is held, but inherited lock objects also
-    inherit the parent's ownership bookkeeping — replace them wholesale."""
+    inherit the parent's ownership bookkeeping — replace them wholesale.
+
+    ``serving=False`` is the zygote's variant: locks only, none of the
+    thread-spawning re-arms (groupings warm, namespace watchers, OTLP
+    flusher) — the zygote must stay single-threaded so its own forks are
+    trivially safe, and it serves nothing that needs them."""
     import threading as th
 
     store = registry.store()
@@ -115,14 +149,17 @@ def _reset_inherited_locks(registry) -> None:
     if ov is not None:
         ov._lock = th.Lock()
         ov._groupings_build_lock = th.Lock()
-        # the parent's warm thread (if any) didn't survive the fork;
-        # re-kick it so a child's first interior delete doesn't pay the
-        # O(E log E) build inside its drain
-        ov.warm_groupings_async()
+        if serving:
+            # the parent's warm thread (if any) didn't survive the fork;
+            # re-kick it so a child's first interior delete doesn't pay the
+            # O(E log E) build inside its drain
+            ov.warm_groupings_async()
     if hasattr(engine, "allow_device_builds"):
         # jax is fork-unsafe: a replica that outgrows its overlay falls
         # back to the live-store oracle instead of a device rebuild
         engine.allow_device_builds = False
+    if not serving:
+        return
     # namespace watchers lose their poll/reader thread at fork (only the
     # forking thread survives); re-arm them so children keep tracking
     # namespace changes
@@ -137,14 +174,42 @@ def _reset_inherited_locks(registry) -> None:
         registry._tracer.restart_after_fork()
 
 
+class _Link:
+    """Parent's handle on one replica: pid (-1 until known — mid-fork, or a
+    zygote respawn whose pid report is in flight), the delta socket, and a
+    send lock serializing the two parent-side writers (the store's
+    broadcast thread and the supervisor's resync replays) so frames never
+    interleave mid-frame."""
+
+    __slots__ = ("pid", "sock", "lock")
+
+    def __init__(self, pid: int, sock: socket.socket):
+        self.pid = pid
+        self.sock = sock
+        self.lock = threading.Lock()
+
+
 class ReplicaPool:
     """Forks `n_replicas - 1` children (the parent serves as replica 0)."""
 
     def __init__(self, registry, n_replicas: int):
         self.registry = registry
         self.n_replicas = n_replicas
-        self._children: list[tuple[int, socket.socket]] = []
+        self._children: list[_Link] = []
         self._bcast_lock = threading.Lock()
+        self._zygote: Optional[_Link] = None
+        self._zygote_pid = -1
+        self._ports: tuple[int, int, int] = (0, 0, 0)
+        # bounded replay window for the resync handshake: (version, frame)
+        self._delta_log: deque = deque(maxlen=self.DELTA_LOG_FRAMES)
+        self._log_lock = threading.Lock()
+        self._pending_spawns: deque = deque()  # links awaiting a pid report
+        self._supervisor: Optional[threading.Thread] = None
+        self._stopping = False
+        self._wake_r: Optional[socket.socket] = None
+        self._wake_w: Optional[socket.socket] = None
+        self._m_respawns = None
+        self._m_resyncs = None
 
     # -- parent side -----------------------------------------------------------
 
@@ -161,6 +226,22 @@ class ReplicaPool:
         # inventory FIRST: failing after subscribing would leave a
         # zero-child pool paying pickle costs on every future write
         self._enforce_fork_inventory()
+        self._ports = (read_port, grpc_port, http_port)
+        metrics = self.registry.metrics()
+        self._m_respawns = metrics.counter(
+            "keto_replica_respawns_total",
+            "dead read replicas replaced by the supervisor (zygote forks)",
+        )
+        self._m_resyncs = metrics.counter(
+            "keto_replica_resyncs_total",
+            "delta-log replays served to lagging or freshly-spawned "
+            "replicas",
+        )
+        metrics.gauge(
+            "keto_replica_children",
+            "live forked read replicas (excludes the parent, replica 0)",
+            fn=lambda: len(self._children),
+        )
         store = self.registry.store()
         subscribe = getattr(store, "subscribe_deltas", None)
         if self.n_replicas > 1 and subscribe is not None:
@@ -168,7 +249,9 @@ class ReplicaPool:
         import warnings
 
         try:
+            self._fork_zygote(warnings)
             self._fork_loop(read_port, grpc_port, http_port, warnings)
+            self._start_supervisor()
         except BaseException:
             # a failed bring-up must not leave the write path taxed by a
             # subscription nobody consumes
@@ -178,40 +261,70 @@ class ReplicaPool:
             self.stop()
             raise
 
+    def _quiet_fork(self, warnings) -> int:
+        with warnings.catch_warnings():
+            # The inventory check enforced the invariant these heuristic
+            # warnings guard (no unexpected Python threads; callers
+            # quiesced engine/warmup threads before calling). jax's
+            # unconditional fork RuntimeWarning also fires once jax is
+            # merely imported; children never call into jax
+            # (allow_device_builds is cleared post-fork).
+            warnings.filterwarnings(
+                "ignore", message=".*fork.*", category=DeprecationWarning
+            )
+            warnings.filterwarnings(
+                "ignore", message=".*fork.*", category=RuntimeWarning
+            )
+            return os.fork()
+
+    def _fork_zygote(self, warnings) -> None:
+        """Fork the non-serving zygote FIRST — while this process can still
+        legally fork. It is the only source of replacement replicas once
+        the parent's gRPC server exists."""
+        if self.n_replicas <= 1:
+            return
+        parent_sock, child_sock = socket.socketpair()
+        # register before forking, same reasoning as _fork_loop: deltas
+        # broadcast mid-fork sit in the buffer; the zygote drops stale ones
+        with self._bcast_lock:
+            self._zygote = _Link(-1, parent_sock)
+        try:
+            pid = self._quiet_fork(warnings)
+        except BaseException:
+            with self._bcast_lock:
+                self._zygote = None
+            parent_sock.close()
+            child_sock.close()
+            raise
+        if pid == 0:
+            parent_sock.close()
+            try:
+                self._zygote_main(child_sock)
+            finally:
+                os._exit(0)
+        child_sock.close()
+        self._zygote_pid = pid
+        with self._bcast_lock:
+            if self._zygote is not None:
+                self._zygote.pid = pid
+
     def _fork_loop(self, read_port, grpc_port, http_port, warnings):
         for i in range(1, self.n_replicas):
             parent_sock, child_sock = socket.socketpair()
+            link = _Link(-1, parent_sock)
             # register the socket BEFORE forking: a delta broadcast landing
             # between fork and registration would reach neither the child's
             # socket nor its fork snapshot — a permanent version gap. Frames
             # broadcast pre-fork sit in the socketpair buffer, are inherited
             # by the child, and are dropped by _feed's stale-version guard.
             with self._bcast_lock:  # _broadcast may be iterating
-                self._children.append((-1, parent_sock))
+                self._children.append(link)
             try:
-                with warnings.catch_warnings():
-                    # The inventory check above enforced the invariant
-                    # these heuristic warnings guard (no unexpected Python
-                    # threads; callers quiesced engine/warmup threads
-                    # before calling). jax's unconditional fork
-                    # RuntimeWarning also fires once jax is merely
-                    # imported; children never call into jax
-                    # (allow_device_builds is cleared post-fork).
-                    warnings.filterwarnings(
-                        "ignore",
-                        message=".*fork.*",
-                        category=DeprecationWarning,
-                    )
-                    warnings.filterwarnings(
-                        "ignore",
-                        message=".*fork.*",
-                        category=RuntimeWarning,
-                    )
-                    pid = os.fork()
+                pid = self._quiet_fork(warnings)
             except BaseException:
                 with self._bcast_lock:
-                    if (-1, parent_sock) in self._children:
-                        self._children.remove((-1, parent_sock))
+                    if link in self._children:
+                        self._children.remove(link)
                 parent_sock.close()
                 child_sock.close()
                 raise
@@ -225,9 +338,8 @@ class ReplicaPool:
                     os._exit(0)
             child_sock.close()
             with self._bcast_lock:
-                if (-1, parent_sock) in self._children:
-                    self._children.remove((-1, parent_sock))
-                    self._children.append((pid, parent_sock))
+                if link in self._children:
+                    link.pid = pid
                 else:
                     # _broadcast pruned the placeholder (send timeout
                     # during the fork window): the child cannot receive
@@ -283,57 +395,383 @@ class ReplicaPool:
     # killed: the write path must never block on a sick reader (its replica
     # store would diverge if we skipped deltas instead)
     SEND_TIMEOUT_S = 5.0
+    # resync replay window. A replica whose gap starts older than this
+    # many frames is restarted (respawned near-current from the zygote)
+    # instead of replayed — bounding both parent memory and replay time.
+    DELTA_LOG_FRAMES = 4096
+
+    def _send_to(self, link: _Link, payload: bytes) -> None:
+        with link.lock:
+            link.sock.settimeout(self.SEND_TIMEOUT_S)
+            _send_frame(link.sock, payload)
 
     def _broadcast(self, version, inserted, deleted) -> None:
-        """Forward one store delta to every replica (writer thread).
-        Bounded: a stalled replica is terminated and pruned rather than
-        wedging every subsequent write behind a full socket buffer."""
+        """Forward one store delta to every replica and the zygote (writer
+        thread). Bounded: a stalled replica is terminated and pruned rather
+        than wedging every subsequent write behind a full socket buffer."""
         payload = pickle.dumps(
-            (version, list(inserted or []), list(deleted or [])),
+            ("delta", version, list(inserted or []), list(deleted or [])),
             protocol=pickle.HIGHEST_PROTOCOL,
         )
+        with self._log_lock:
+            self._delta_log.append((version, payload))
+        # fault site: silently skip this frame for ONE serving replica —
+        # the version gap the resync handshake exists to detect and fill
+        drop_one = FAULTS.should_fire("delta.drop")
         with self._bcast_lock:
-            dead = []
-            for pid, sock in self._children:
+            links = list(self._children)
+            zygote = self._zygote
+        dead = []
+        for link in links:
+            if drop_one:
+                drop_one = False
+                continue
+            try:
+                self._send_to(link, payload)
+            except (OSError, socket.timeout):
+                dead.append(link)
+        if zygote is not None:
+            try:
+                self._send_to(zygote, payload)
+            except (OSError, socket.timeout):
+                # a wedged zygote cannot fork fresh replicas anyway; drop
+                # it rather than stall the write path (respawn capability
+                # is lost — the supervisor logs when it next needs it)
+                self._drop_zygote(zygote)
+        for link in dead:
+            self._kill_link(link)
+
+    def _kill_link(self, link: _Link) -> None:
+        with self._bcast_lock:
+            if link in self._children:
+                self._children.remove(link)
+        try:
+            link.sock.close()
+        except OSError:
+            pass
+        # pid < 0 marks a mid-fork placeholder: never os.kill a negative
+        # pid (that signals the process GROUP)
+        if link.pid > 0:
+            try:
+                os.kill(link.pid, 9)  # it can't serve fresh reads now
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                # zygote-forked replicas are grandchildren: not ours to
+                # reap (the zygote ignores SIGCHLD so the kernel does it)
+                os.waitpid(link.pid, os.WNOHANG)
+            except (ChildProcessError, OSError):
+                pass
+
+    def _drop_zygote(self, zygote: _Link) -> None:
+        with self._bcast_lock:
+            if self._zygote is zygote:
+                self._zygote = None
+        try:
+            zygote.sock.close()
+        except OSError:
+            pass
+        if zygote.pid > 0:
+            try:
+                os.kill(zygote.pid, 9)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                os.waitpid(zygote.pid, os.WNOHANG)
+            except (ChildProcessError, OSError):
+                pass
+
+    # -- supervisor ------------------------------------------------------------
+
+    def _start_supervisor(self) -> None:
+        if self.n_replicas <= 1:
+            return
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="replica-supervisor", daemon=True
+        )
+        self._supervisor.start()
+
+    def _supervise(self) -> None:
+        """select() on every replica socket + the zygote socket. Readable
+        means a control frame (resync request, spawned-pid report) or EOF
+        (death). EOF-based death detection works uniformly for direct
+        children AND zygote-forked grandchildren, which waitpid cannot
+        see."""
+        log = self.registry.logger()
+        while not self._stopping:
+            with self._bcast_lock:
+                links = list(self._children)
+                zygote = self._zygote
+            socks = {l.sock: l for l in links}
+            rlist = list(socks) + [self._wake_r]
+            if zygote is not None:
+                rlist.append(zygote.sock)
+            try:
+                readable, _, _ = select.select(rlist, [], [], 1.0)
+            except (OSError, ValueError):
+                continue  # a sock was pruned/closed mid-select; re-snapshot
+            for sock in readable:
+                if self._stopping:
+                    return
+                if sock is self._wake_r:
+                    return  # stop() woke us
+                if zygote is not None and sock is zygote.sock:
+                    self._read_zygote(zygote, log)
+                    continue
+                link = socks.get(sock)
+                if link is not None:
+                    self._read_child(link, log)
+
+    def _read_child(self, link: _Link, log) -> None:
+        try:
+            frame = _recv_frame(link.sock)
+        except OSError:
+            frame = None
+        if frame is None:
+            # replica died (crash, SIGKILL, injected replica.crash):
+            # prune and replace it
+            log.warn("read replica died; respawning", pid=link.pid)
+            self._kill_link(link)
+            self._respawn(log)
+            return
+        try:
+            msg = pickle.loads(frame)
+        except Exception:
+            log.warn("garbled control frame from replica", pid=link.pid)
+            return
+        if msg[0] == "resync":
+            self._resync(link, int(msg[1]), log)
+
+    def _resync(self, link: _Link, have_version: int, log) -> None:
+        """Replay versions (have_version, current] from the delta log, or
+        order a restart when the gap predates the log."""
+        store = self.registry.store()
+        with self._log_lock:
+            frames = [
+                (v, payload)
+                for v, payload in self._delta_log
+                if v > have_version
+            ]
+            oldest_logged = self._delta_log[0][0] if self._delta_log else None
+        need_from = have_version + 1
+        if (
+            store.version > have_version
+            and (oldest_logged is None or need_from < oldest_logged)
+        ):
+            # the gap starts before the replay window: this replica can
+            # never catch up frame-by-frame — restart it fresh from the
+            # near-current zygote instead
+            log.warn(
+                "replica gap predates the delta log; restarting replica",
+                pid=link.pid,
+                have_version=have_version,
+                oldest_logged=oldest_logged,
+            )
+            try:
+                self._send_to(link, pickle.dumps(("restart",)))
+            except (OSError, socket.timeout):
+                self._kill_link(link)
+                self._respawn(log)
+            return
+        if self._m_resyncs is not None:
+            self._m_resyncs.inc()
+        try:
+            for _v, payload in frames:
+                self._send_to(link, payload)
+        except (OSError, socket.timeout):
+            self._kill_link(link)
+            self._respawn(log)
+            return
+        if frames:
+            log.info(
+                "replayed delta log to replica",
+                pid=link.pid,
+                frames=len(frames),
+                from_version=need_from,
+            )
+
+    def _respawn(self, log) -> None:
+        """Ask the zygote for a replacement replica. The new delta socket
+        is created HERE and its child end shipped to the zygote by
+        fd-passing, so the parent can register it (and start buffering
+        broadcasts to it) before the replacement even exists."""
+        with self._bcast_lock:
+            zygote = self._zygote
+        if zygote is None:
+            log.warn(
+                "no zygote available; pool capacity permanently reduced",
+                children=len(self._children),
+            )
+            return
+        parent_sock, child_sock = socket.socketpair()
+        link = _Link(-1, parent_sock)
+        with self._bcast_lock:
+            self._children.append(link)
+        self._pending_spawns.append(link)
+        try:
+            # current fault snapshot rides along: a fault armed at boot
+            # and since disarmed must not resurrect in the replacement
+            cmd = pickle.dumps(
+                ("spawn", self._ports, FAULTS.snapshot()),
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+            with zygote.lock:
+                zygote.sock.settimeout(self.SEND_TIMEOUT_S)
+                _send_frame(zygote.sock, cmd)
+                # the fd must follow its command 1:1 — same lock hold
+                socket.send_fds(zygote.sock, [b"F"], [child_sock.fileno()])
+        except (OSError, socket.timeout):
+            with self._bcast_lock:
+                if link in self._children:
+                    self._children.remove(link)
+            if link in self._pending_spawns:
+                self._pending_spawns.remove(link)
+            parent_sock.close()
+            self._drop_zygote(zygote)
+            log.warn("zygote unreachable; pool capacity permanently reduced")
+        else:
+            if self._m_respawns is not None:
+                self._m_respawns.inc()
+        finally:
+            child_sock.close()
+
+    def _read_zygote(self, zygote: _Link, log) -> None:
+        try:
+            frame = _recv_frame(zygote.sock)
+        except OSError:
+            frame = None
+        if frame is None:
+            self._drop_zygote(zygote)
+            log.warn(
+                "zygote died; dead replicas can no longer be respawned"
+            )
+            return
+        try:
+            msg = pickle.loads(frame)
+        except Exception:
+            return
+        if msg[0] == "spawned" and self._pending_spawns:
+            link = self._pending_spawns.popleft()
+            pid = int(msg[1])
+            with self._bcast_lock:
+                present = link in self._children
+                if present:
+                    link.pid = pid
+            if not present:
+                # the placeholder was pruned (stalled during spawn): the
+                # replacement must not serve without a delta feed
                 try:
-                    sock.settimeout(self.SEND_TIMEOUT_S)
-                    _send_frame(sock, payload)
-                except (OSError, socket.timeout):
-                    dead.append((pid, sock))
-            for pid, sock in dead:
-                try:
-                    sock.close()
-                except OSError:
+                    os.kill(pid, 9)
+                except (ProcessLookupError, PermissionError):
                     pass
-                # pid < 0 marks a mid-fork placeholder: never os.kill a
-                # negative pid (that signals the process GROUP)
-                if pid > 0:
-                    try:
-                        os.kill(pid, 9)  # it can't serve fresh reads now
-                        os.waitpid(pid, 0)
-                    except (ProcessLookupError, ChildProcessError):
-                        pass
-                self._children.remove((pid, sock))
 
     def stop(self) -> None:
+        self._stopping = True
+        unsub = getattr(self.registry.store(), "unsubscribe_deltas", None)
+        if unsub is not None:
+            unsub(self._broadcast)
+        if self._wake_w is not None:
+            try:
+                self._wake_w.send(b"x")
+            except OSError:
+                pass
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5)
+            self._supervisor = None
         with self._bcast_lock:
-            for pid, sock in self._children:
+            links = list(self._children)
+            self._children.clear()
+            zygote = self._zygote
+            self._zygote = None
+        if zygote is not None:
+            links.append(zygote)
+        for link in links:
+            try:
+                link.sock.close()
+            except OSError:
+                pass
+            if link.pid > 0:
                 try:
-                    sock.close()
+                    os.kill(link.pid, 15)
+                except ProcessLookupError:
+                    pass
+        for link in links:
+            if link.pid > 0:
+                try:
+                    os.waitpid(link.pid, 0)
+                except (ChildProcessError, OSError):
+                    pass  # grandchildren are reaped by the kernel
+        for s in (self._wake_r, self._wake_w):
+            if s is not None:
+                try:
+                    s.close()
                 except OSError:
                     pass
-                if pid > 0:
+        self._wake_r = self._wake_w = None
+
+    # -- zygote ----------------------------------------------------------------
+
+    def _zygote_main(self, sock: socket.socket) -> None:
+        """Non-serving fork source. Single-threaded by construction: one
+        loop applies delta frames (keeping the inherited store fresh, so
+        respawned replicas start near-current) and forks replacement
+        replicas on spawn commands. Forking here is always safe — no gRPC,
+        no asyncio, no extra threads."""
+        import signal
+
+        # replacement replicas are THIS process's children; auto-reap them
+        # so a dead grandchild never lingers as a zombie nobody waits on
+        signal.signal(signal.SIGCHLD, signal.SIG_IGN)
+        reg = self.registry
+        _reset_inherited_locks(reg, serving=False)
+        unsub = getattr(reg.store(), "unsubscribe_deltas", None)
+        if unsub is not None:
+            unsub(self._broadcast)
+        import gc
+
+        gc.freeze()
+        store = reg.store()
+        held: dict[int, tuple] = {}
+        MAX_HELD = 1024
+        while True:
+            frame = _recv_frame(sock)
+            if frame is None:
+                os._exit(0)  # parent went away
+            msg = pickle.loads(frame)
+            if msg[0] == "delta":
+                _, version, inserted, deleted = msg
+                if version <= store.version:
+                    continue  # inherited pre-fork frame
+                held[version] = (inserted, deleted)
+                while (nxt := store.version + 1) in held:
+                    ins, dels = held.pop(nxt)
+                    store.transact_relation_tuples(ins, dels)
+                if len(held) > MAX_HELD:
+                    os._exit(3)  # unfillable gap: a stale zygote would
+                    # respawn replicas the delta log cannot catch up
+            elif msg[0] == "spawn":
+                _, ports, fault_snapshot = msg
+                _msg, fds, _flags, _addr = socket.recv_fds(sock, 1, 1)
+                if not fds:
+                    continue
+                fd = fds[0]
+                # the parent's CURRENT fault state, not the boot state we
+                # inherited: disarmed faults must not resurrect
+                FAULTS.load(fault_snapshot)
+                pid = os.fork()
+                if pid == 0:
+                    sock.close()
+                    child_sock = socket.socket(fileno=fd)
                     try:
-                        os.kill(pid, 15)
-                    except ProcessLookupError:
-                        pass
-            for pid, _ in self._children:
-                if pid > 0:
-                    try:
-                        os.waitpid(pid, 0)
-                    except ChildProcessError:
-                        pass
-            self._children.clear()
+                        self._child_main(0, child_sock, *ports)
+                    finally:
+                        os._exit(0)
+                os.close(fd)
+                try:
+                    _send_frame(sock, pickle.dumps(("spawned", pid)))
+                except OSError:
+                    os._exit(0)
 
     # -- child side ------------------------------------------------------------
 
@@ -350,12 +788,18 @@ class ReplicaPool:
         # sockets (writing to them would interleave corrupt frames into
         # the parent's stream) and the store->_broadcast subscription
         # (a replica applying a delta must not re-broadcast it).
-        for _pid, s in self._children:
+        for link in self._children:
             try:
-                s.close()
+                link.sock.close()
             except OSError:
                 pass
         self._children = []
+        if self._zygote is not None:
+            try:
+                self._zygote.sock.close()
+            except OSError:
+                pass
+            self._zygote = None
         unsub = getattr(reg.store(), "unsubscribe_deltas", None)
         if unsub is not None:
             unsub(self._broadcast)
@@ -370,23 +814,43 @@ class ReplicaPool:
         def _feed() -> None:
             # The store's OrderedNotifier guarantees the parent broadcasts
             # deltas in version order, so frames normally arrive contiguous.
-            # Defense in depth (ADVICE r4): if a frame ever arrives early,
-            # hold it and apply when its predecessors land instead of
-            # os._exit(3)ing and silently collapsing the pool. Only an
-            # unfillable gap (bound exceeded) is fatal.
+            # A frame arriving EARLY (a dropped predecessor, or a respawn
+            # whose zygote state lags the stream) is held while the parent
+            # is asked to replay the gap from its delta log — the resync
+            # handshake. Only an unfillable gap (hold bound exceeded, or
+            # the parent ordering a restart) is fatal, and fatal here is
+            # recoverable: the supervisor respawns this replica fresh.
             held: dict[int, tuple] = {}
             MAX_HELD = 1024
+            resync_requested = False
+            # boot handshake: tell the parent where this replica's store
+            # starts. Direct forks start current (replays nothing);
+            # zygote respawns start wherever the zygote had applied to,
+            # and the replay fills the difference.
+            _send_frame(sock, pickle.dumps(("resync", store.version)))
             while True:
                 frame = _recv_frame(sock)
                 if frame is None:
                     os._exit(0)  # parent went away
-                version, inserted, deleted = pickle.loads(frame)
+                msg = pickle.loads(frame)
+                if msg[0] == "restart":
+                    # the parent's delta log cannot catch us up; exit so
+                    # the supervisor respawns us near-current
+                    os._exit(5)
+                if msg[0] != "delta":
+                    continue
+                _, version, inserted, deleted = msg
                 if version <= store.version:
                     # pre-fork frame (the forked store already contains
-                    # this write) or duplicate: already reflected — drop,
-                    # never hold (a held stale frame can never apply and
-                    # would count toward MAX_HELD forever)
+                    # this write), duplicate, or resync-replay overlap:
+                    # already reflected — drop, never hold (a held stale
+                    # frame can never apply and would count toward
+                    # MAX_HELD forever)
                     continue
+                # fault site: die exactly where a sick replica would —
+                # with a delta in hand, before applying it
+                if FAULTS.should_fire("replica.crash"):
+                    os._exit(9)
                 held[version] = (inserted, deleted)
                 while (nxt := store.version + 1) in held:
                     ins, dels = held.pop(nxt)
@@ -395,9 +859,19 @@ class ReplicaPool:
                         # applying one frame must bump exactly once; a
                         # drifted replica cannot serve fresh reads
                         os._exit(3)
+                if held and not resync_requested:
+                    # a version gap: ask the parent to replay it instead
+                    # of waiting for frames that may never come
+                    _send_frame(
+                        sock, pickle.dumps(("resync", store.version))
+                    )
+                    resync_requested = True
+                elif not held:
+                    resync_requested = False
                 if len(held) > MAX_HELD:
-                    # a version in the gap will never arrive — die loudly
-                    # rather than serve ever-staler answers
+                    # a version in the gap outlived the parent's replay
+                    # window — die loudly rather than serve ever-staler
+                    # answers; the supervisor respawns us fresh
                     os._exit(3)
 
         threading.Thread(target=_feed, daemon=True).start()

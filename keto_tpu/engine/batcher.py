@@ -7,6 +7,22 @@ request. The batcher is that seam: callers block on ``check()``, a dispatcher
 thread drains the queue into one ``DeviceCheckEngine.batch_check`` call —
 taking whatever has accumulated while the previous batch was on device (the
 natural batching window), plus a tiny fixed window when the queue is empty.
+
+Because every caller funnels through ONE dispatcher thread, that thread is
+shared-fate for the whole read plane — so it is supervised:
+
+- **watchdog**: if the dispatcher dies outside the per-batch engine guard
+  (a bug, an injected ``batcher.dispatcher_die`` fault), the guard fails
+  the in-flight batch with :class:`DispatcherCrashed` and restarts the
+  thread; queued-but-undispatched requests survive and are answered by the
+  replacement.
+- **bounded queue**: past ``max_queue`` waiting requests the batcher sheds
+  load with :class:`BatcherOverloaded` (HTTP 429 / gRPC RESOURCE_EXHAUSTED
+  at the transports) instead of growing the queue — and the latency of
+  everything behind it — without bound.
+- **typed shutdown**: after ``close()`` no caller can hang past the join
+  budget; anything still queued or in flight fails with
+  :class:`BatcherClosed`.
 """
 
 from __future__ import annotations
@@ -16,7 +32,32 @@ import time
 from concurrent.futures import Future
 from typing import Optional, Sequence
 
+from ..faults import FAULTS
 from ..relationtuple.definitions import RelationTuple
+from ..utils.errors import ErrInternal, ErrResourceExhausted, ErrUnavailable
+
+
+class BatcherClosed(ErrUnavailable):
+    """The batcher was shut down: rebuilds stopped, so cached answers could
+    no longer be invalidated and must not be served either."""
+
+    def default_message(self) -> str:
+        return "The check batcher is closed (server shutting down)."
+
+
+class BatcherOverloaded(ErrResourceExhausted):
+    """The dispatch queue is full; this request was shed."""
+
+    def default_message(self) -> str:
+        return "The check queue is full; retry with backoff."
+
+
+class DispatcherCrashed(ErrInternal):
+    """The dispatcher thread died while this request was in flight; the
+    watchdog restarted it. The request was NOT answered — retryable."""
+
+    def default_message(self) -> str:
+        return "The check dispatcher crashed mid-batch and was restarted."
 
 
 class CheckBatcher:
@@ -29,29 +70,57 @@ class CheckBatcher:
         cache=None,  # CheckResultCache; None disables
         version_fn=None,  # ANSWERING-version supplier for cache stamping
         # (engine.answering_version — not served_version, which lags writes)
+        max_queue: int = 0,  # 0 -> 8 * max_batch
+        logger=None,
     ):
         self.engine = engine
         self.max_batch = max_batch
         self.window_s = window_s
         self.cache = cache
         self.version_fn = version_fn
-        self._m_batch_size = (
-            metrics.histogram(
+        self.max_queue = max_queue if max_queue > 0 else 8 * max_batch
+        self._logger = logger
+        self._m_batch_size = None
+        self._m_shed = None
+        self._m_restarts = None
+        if metrics is not None:
+            self._m_batch_size = metrics.histogram(
                 "keto_batcher_batch_size",
                 "requests coalesced per dispatched batch",
                 buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
             )
-            if metrics is not None
-            else None
-        )
+            self._m_shed = metrics.counter(
+                "keto_batcher_shed_total",
+                "check requests rejected because the dispatch queue was full",
+            )
+            self._m_restarts = metrics.counter(
+                "keto_batcher_dispatcher_restarts_total",
+                "dispatcher thread deaths recovered by the watchdog",
+            )
+            metrics.gauge(
+                "keto_batcher_queue_depth",
+                "check requests waiting for dispatch",
+                fn=lambda: len(self._queue),
+            )
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: list[tuple[RelationTuple, int, Future]] = []
+        # the batch the dispatcher popped but has not answered yet — the
+        # watchdog fails exactly these on a dispatcher death, and close()
+        # fails them after the join budget
+        self._inflight: list[tuple[RelationTuple, int, Future]] = []
         self._closed = False
-        self._thread = threading.Thread(
-            target=self._run, name="check-batcher", daemon=True
+        # close() lets the dispatcher drain for this long before failing
+        # the leftovers typed; only a wedged engine ever exhausts it
+        self.close_join_s = 5.0
+        self._thread = self._spawn_dispatcher()
+
+    def _spawn_dispatcher(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self._run_guard, name="check-batcher", daemon=True
         )
-        self._thread.start()
+        t.start()
+        return t
 
     def check(
         self,
@@ -61,9 +130,7 @@ class CheckBatcher:
         min_version: int = 0,
     ) -> bool:
         if self._closed:
-            # closed means rebuilds stopped: cached answers could no
-            # longer be invalidated, so they must not be served either
-            raise RuntimeError("batcher closed")
+            raise BatcherClosed()
         if min_version > 0:
             # at-least-as-fresh consistency (CheckRequest.snaptoken): make
             # the serving snapshot catch up before answering. The cache is
@@ -83,7 +150,15 @@ class CheckBatcher:
         f: Future = Future()
         with self._cv:
             if self._closed:
-                raise RuntimeError("batcher closed")
+                raise BatcherClosed()
+            if len(self._queue) >= self.max_queue:
+                # shed at admission: a full queue means the engine is
+                # already saturated max_queue/max_batch dispatches deep —
+                # queueing further only converts overload into latency
+                # for every caller
+                if self._m_shed is not None:
+                    self._m_shed.inc()
+                raise BatcherOverloaded()
             self._queue.append((request, max_depth, f))
             self._cv.notify()
         result = f.result(timeout=timeout)
@@ -102,6 +177,8 @@ class CheckBatcher:
         queue and dispatches directly (the batch-check transport path).
         `min_version` applies the at-least-as-fresh contract to the whole
         batch before dispatch, bounded by `timeout` (the RPC deadline)."""
+        if self._closed:
+            raise BatcherClosed()
         if min_version > 0:
             wait = getattr(self.engine, "wait_for_version", None)
             if wait is not None:
@@ -116,8 +193,19 @@ class CheckBatcher:
     def close(self) -> None:
         with self._cv:
             self._closed = True
-            self._cv.notify()
-        self._thread.join(timeout=5)
+            self._cv.notify_all()
+        # the dispatcher drains the queue before exiting; the join budget
+        # only runs out when the engine itself is wedged (the sick-chip
+        # hang-not-raise mode) — then every waiter is failed typed instead
+        # of hanging past shutdown
+        self._thread.join(timeout=self.close_join_s)
+        with self._cv:
+            leftovers = self._queue + self._inflight
+            self._queue = []
+            self._inflight = []
+        for _, _, f in leftovers:
+            if not f.done():
+                f.set_exception(BatcherClosed())
 
     # -- dispatcher ----------------------------------------------------------
 
@@ -126,8 +214,36 @@ class CheckBatcher:
         del self._queue[: len(batch)]
         return batch
 
+    def _run_guard(self) -> None:
+        """Watchdog shell around the dispatch loop: a dispatcher death must
+        not strand callers (their futures would never resolve) or kill
+        batching for the process lifetime. In-flight futures fail typed;
+        queued ones survive for the replacement thread."""
+        while True:
+            try:
+                self._run()
+                return  # clean close
+            except BaseException:
+                with self._cv:
+                    inflight = self._inflight
+                    self._inflight = []
+                    closed = self._closed
+                for _, _, f in inflight:
+                    if not f.done():
+                        f.set_exception(DispatcherCrashed())
+                if self._m_restarts is not None:
+                    self._m_restarts.inc()
+                if self._logger is not None:
+                    self._logger.warn(
+                        "check dispatcher died; restarting",
+                        failed_inflight=len(inflight),
+                    )
+                if closed:
+                    return
+
     def _run(self) -> None:
         while True:
+            FAULTS.fire("batcher.dispatcher_die")
             with self._cv:
                 while not self._queue and not self._closed:
                     self._cv.wait()
@@ -140,6 +256,7 @@ class CheckBatcher:
                 time.sleep(self.window_s)
             with self._cv:
                 batch = self._drain()
+                self._inflight = batch
             if not batch:
                 continue
             if self._m_batch_size is not None:
@@ -152,10 +269,14 @@ class CheckBatcher:
                 for _, _, f in batch:
                     if not f.done():
                         f.set_exception(e)
+                with self._cv:
+                    self._inflight = []
                 continue
             for (_, _, f), allowed in zip(batch, results):
                 if not f.done():
                     f.set_result(bool(allowed))
+            with self._cv:
+                self._inflight = []
 
 
 def dispatch_batched(

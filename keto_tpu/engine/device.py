@@ -28,6 +28,7 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from ..faults import FAULTS
 from ..graph.snapshot import GraphSnapshot, SnapshotManager, _bucket
 from ..ops.frontier import (
     batched_check_dense,
@@ -169,6 +170,12 @@ class DeviceCheckEngine:
         """Evaluate a batch; `depths` (per-request) overrides `max_depth`."""
         if not requests:
             return []
+        # fault sites: stand-ins for an XLA compile failure and for a
+        # numerically sick chip returning garbage — the circuit breaker in
+        # engine/fallback.py is tested against exactly these
+        FAULTS.fire("device.compile_error")
+        if FAULTS.should_fire("device.batch_nan"):
+            return [float("nan")] * len(requests)
         snap = self.snapshots.snapshot()
         dg = self._device_graph(snap)
         n = len(requests)

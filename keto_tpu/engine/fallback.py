@@ -1,0 +1,239 @@
+"""Device-engine circuit breaker: degrade to the host oracle, never crash.
+
+The compiled device engine is shared-fate for every check in the process: an
+XLA compile error, a driver wedge, or a numerically sick chip (batches full
+of NaN — hardware-accelerated retrieval stacks like LogosKG document the
+same failure class) used to surface as an exception on every caller, or
+worse, as silently wrong answers. The read plane needs an explicit degraded
+mode instead of an implicit crash mode:
+
+- every batch answered by the primary engine is *validated* (right length,
+  strictly boolean — a NaN or garbage element is a failure, not an answer);
+- ``failure_threshold`` consecutive failures trip the breaker: checks are
+  served by the exact host oracle (``CheckEngine`` over the live store) for
+  ``cooldown_s``, the health service drops to NOT_SERVING so balancers
+  deprioritize this process (it still answers, slower), and a telemetry
+  counter records every fallback-served batch;
+- after the cooldown one probe batch rides the primary (half-open); success
+  closes the breaker and restores SERVING, failure re-opens it with
+  doubled cooldown (capped).
+
+The wrapper is transparent: everything the batcher/registry reach through
+(``wait_for_version``, ``answering_version``, ``warmup``, ...) delegates to
+the primary engine.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+from ..relationtuple.definitions import RelationTuple
+
+_COOLDOWN_CAP_S = 60.0
+
+
+def _valid_batch(results, n: int) -> bool:
+    """The engine contract is list[bool] of the batch length. Anything else
+    (short batch, NaN, floats, None) is a sick-device symptom: treat it as
+    a failure rather than bool()-coercing garbage into an answer."""
+    try:
+        if len(results) != n:
+            return False
+        for v in results:
+            # bool and numpy.bool_ are fine; exact 0/1 integers are fine
+            # (int is not bool, so check values); everything else — float
+            # NaN included — is garbage
+            if isinstance(v, bool):
+                continue
+            if type(v).__name__ == "bool_":  # numpy scalar, no hard dep
+                continue
+            if isinstance(v, int) and v in (0, 1):
+                continue
+            return False
+    except TypeError:
+        return False
+    return True
+
+
+class DeviceFallbackEngine:
+    """Circuit breaker around a device-backed check engine with a host
+    (exact oracle) fallback.
+
+    ``fallback_factory`` is called at most once, on first need — the host
+    oracle over the live store is cheap to build but there is no reason to
+    pay it on healthy boots.
+    """
+
+    def __init__(
+        self,
+        primary,
+        fallback_factory,
+        failure_threshold: int = 3,
+        cooldown_s: float = 1.0,
+        health=None,  # HealthServicer; breaker drives SERVING/NOT_SERVING
+        metrics=None,
+        logger=None,
+        clock=time.monotonic,
+    ):
+        self.primary = primary
+        self._fallback_factory = fallback_factory
+        self._fallback = None
+        self.failure_threshold = max(1, failure_threshold)
+        self.base_cooldown_s = cooldown_s
+        self.health = health
+        self._logger = logger
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._open_until: Optional[float] = None  # None = closed
+        self._cooldown_s = cooldown_s
+        self._probing = False  # half-open: one probe at a time
+        self._degraded_health = False  # only restore what WE took down
+        self._m_failures = None
+        self._m_fallback_batches = None
+        self._m_open = None
+        if metrics is not None:
+            self._m_failures = metrics.counter(
+                "keto_device_engine_failures_total",
+                "device engine batches that raised or returned invalid output",
+            )
+            self._m_fallback_batches = metrics.counter(
+                "keto_device_fallback_batches_total",
+                "check batches answered by the host oracle while the "
+                "device circuit is open",
+            )
+            self._m_open = metrics.gauge(
+                "keto_device_circuit_open",
+                "1 while checks are served by the host fallback",
+            )
+
+    # -- breaker bookkeeping ---------------------------------------------------
+
+    def circuit_open(self) -> bool:
+        with self._lock:
+            return self._open_until is not None
+
+    def _fallback_engine(self):
+        if self._fallback is None:
+            self._fallback = self._fallback_factory()
+        return self._fallback
+
+    def _use_primary(self) -> bool:
+        """Route decision per batch; flips to half-open probe after the
+        cooldown (exactly one concurrent probe — the rest keep falling
+        back until the probe verdict lands)."""
+        with self._lock:
+            if self._open_until is None:
+                return True
+            if self._probing or self._clock() < self._open_until:
+                return False
+            self._probing = True
+            return True
+
+    def _record_failure(self, err: Optional[BaseException]) -> None:
+        if self._m_failures is not None:
+            self._m_failures.inc()
+        with self._lock:
+            self._probing = False
+            self._consecutive_failures += 1
+            was_open = self._open_until is not None
+            if was_open:
+                # failed probe: re-open, back off harder
+                self._cooldown_s = min(self._cooldown_s * 2, _COOLDOWN_CAP_S)
+                self._open_until = self._clock() + self._cooldown_s
+                tripped = False
+            else:
+                tripped = self._consecutive_failures >= self.failure_threshold
+                if tripped:
+                    self._open_until = self._clock() + self._cooldown_s
+            take_health_down = (tripped or was_open) and not self._degraded_health
+            if take_health_down:
+                self._degraded_health = True
+        if tripped or was_open:
+            if self._m_open is not None:
+                self._m_open.set(1)
+            if self._logger is not None:
+                self._logger.warn(
+                    "device engine circuit OPEN; serving checks from the "
+                    "host oracle",
+                    error=str(err) if err is not None else "invalid output",
+                    cooldown_s=self._cooldown_s,
+                )
+        if take_health_down and self.health is not None:
+            self.health.set_serving(False)
+
+    def _record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probing = False
+            recovered = self._open_until is not None
+            self._open_until = None
+            self._cooldown_s = self.base_cooldown_s
+            restore = recovered and self._degraded_health
+            if restore:
+                self._degraded_health = False
+        if recovered:
+            if self._m_open is not None:
+                self._m_open.set(0)
+            if self._logger is not None:
+                self._logger.info(
+                    "device engine circuit CLOSED; primary engine healthy"
+                )
+        if restore and self.health is not None:
+            self.health.set_serving(True)
+
+    # -- check surface ---------------------------------------------------------
+
+    def batch_check(
+        self,
+        requests: Sequence[RelationTuple],
+        max_depth: int = 0,
+        depths: Optional[Sequence[int]] = None,
+    ) -> list[bool]:
+        if not requests:
+            return []
+        if self._use_primary():
+            try:
+                results = self.primary.batch_check(
+                    requests, max_depth, depths=depths
+                )
+            except Exception as e:
+                self._record_failure(e)
+                return self._fallback_check(requests, max_depth, depths)
+            if not _valid_batch(results, len(requests)):
+                self._record_failure(None)
+                return self._fallback_check(requests, max_depth, depths)
+            self._record_success()
+            return [bool(v) for v in results]
+        return self._fallback_check(requests, max_depth, depths)
+
+    def _fallback_check(self, requests, max_depth, depths) -> list[bool]:
+        if self._m_fallback_batches is not None:
+            self._m_fallback_batches.inc()
+        engine = self._fallback_engine()
+        if depths is not None:
+            # the host oracle has no per-request-depth batch entry point;
+            # per-request evaluation is its native shape anyway
+            return [
+                bool(engine.subject_is_allowed(r, d))
+                for r, d in zip(requests, depths)
+            ]
+        return [
+            bool(v) for v in engine.batch_check(requests, max_depth)
+        ] if hasattr(engine, "batch_check") else [
+            bool(engine.subject_is_allowed(r, max_depth)) for r in requests
+        ]
+
+    def subject_is_allowed(
+        self, requested: RelationTuple, max_depth: int = 0
+    ) -> bool:
+        return self.batch_check([requested], max_depth)[0]
+
+    # -- transparency ----------------------------------------------------------
+
+    def __getattr__(self, name):
+        # wait_for_version / answering_version / served_version / warmup /
+        # host_queries / snapshots ... — everything else is the primary's
+        return getattr(self.primary, name)

@@ -1,0 +1,156 @@
+"""Deterministic fault injection: the registry the self-healing plane is
+tested against.
+
+The reference inherits its resilience from process-per-request goroutines
+and a SQL database as the coordination point (SURVEY §2.10) — any one
+request can die without taking the server with it. The TPU-native shape
+traded that for shared-fate components: one dispatcher thread in
+``CheckBatcher``, one delta-stream socketpair per forked replica, one
+compiled device engine. Each of those is a single point whose death used to
+wedge or silently stale the read plane. The recovery paths that now guard
+them (driver/replicas.py supervision + resync, engine/batcher.py watchdog,
+engine/fallback.py circuit breaker) are only trustworthy if they can be
+*driven*, deterministically, in tier-1 tests — which is what this module
+is: named fault sites compiled into the production code paths, armed
+per-process via :data:`FAULTS` or the ``KETO_FAULTS`` environment knob.
+
+Armed sites fire a bounded number of times (never probabilistically: a
+flaky fault is a flaky test), then disarm themselves. Unarmed sites cost
+one dict lookup under a lock — nothing on the hot path fires them per
+request; they sit on failure-handling seams (dispatch loop iterations,
+delta broadcasts, device batch entry).
+
+Known sites (the fault matrix tests/test_faults.py walks):
+
+========================  ====================================================
+site                      effect when armed
+========================  ====================================================
+``replica.crash``         a forked read replica ``os._exit``\\ s while applying
+                          its next delta frame (driver/replicas.py)
+``delta.drop``            the parent skips broadcasting one delta frame to
+                          one serving replica — a silent version gap the
+                          resync handshake must fill (driver/replicas.py)
+``batcher.dispatcher_die``  the CheckBatcher dispatcher thread raises and
+                          dies at the top of its loop; the watchdog must
+                          restart it (engine/batcher.py)
+``device.compile_error``  ``DeviceCheckEngine.batch_check`` raises as an XLA
+                          compile failure would (engine/device.py)
+``device.batch_nan``      the device engine returns non-boolean garbage for
+                          the batch, as a numerically sick chip would
+                          (engine/device.py)
+``client.unavailable``    test-only site for client retry paths
+========================  ====================================================
+
+``KETO_FAULTS`` syntax: comma-separated ``site`` or ``site:count`` entries,
+e.g. ``KETO_FAULTS="delta.drop,device.batch_nan:3"`` (bare site = fire
+once). Parsed once at import; tests arm programmatically instead.
+
+Fork semantics: the registry is plain process memory, so forked replicas
+inherit the armed state at fork time and decrement their own copies — that
+is what makes ``replica.crash`` deterministic per child. The replica
+pool ships its *current* registry snapshot with every respawn command
+(driver/replicas.py) so a fault disarmed in the parent does not resurrect
+in respawned children.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed :meth:`FaultRegistry.fire` site. Deliberately a
+    plain RuntimeError subclass: production recovery paths must treat it
+    exactly like the organic failure it stands in for."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault: {site}")
+        self.site = site
+
+
+class FaultRegistry:
+    """Thread-safe map of site -> remaining fire count."""
+
+    def __init__(self, env: Optional[dict] = None):
+        self._lock = threading.Lock()
+        self._armed: dict[str, int] = {}
+        self._fired: dict[str, int] = {}
+        if env is not None:
+            self.load_env(env)
+
+    # -- arming ---------------------------------------------------------------
+
+    def arm(self, site: str, times: int = 1) -> None:
+        if times <= 0:
+            raise ValueError(f"times must be positive, got {times}")
+        with self._lock:
+            self._armed[site] = self._armed.get(site, 0) + times
+
+    def disarm(self, site: str) -> None:
+        with self._lock:
+            self._armed.pop(site, None)
+
+    def reset(self) -> None:
+        """Disarm everything and zero fire counts (test teardown)."""
+        with self._lock:
+            self._armed.clear()
+            self._fired.clear()
+
+    def load_env(self, env: Optional[dict] = None) -> None:
+        """Arm from ``KETO_FAULTS`` (``site[:count]`` comma list)."""
+        raw = (env if env is not None else os.environ).get("KETO_FAULTS", "")
+        for entry in raw.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            site, _, count = entry.partition(":")
+            self.arm(site.strip(), int(count) if count else 1)
+
+    # -- introspection --------------------------------------------------------
+
+    def armed(self, site: str) -> int:
+        with self._lock:
+            return self._armed.get(site, 0)
+
+    def fired(self, site: str) -> int:
+        with self._lock:
+            return self._fired.get(site, 0)
+
+    def snapshot(self) -> dict[str, int]:
+        """The armed state, for shipping across a process boundary
+        (replica respawn commands carry this)."""
+        with self._lock:
+            return dict(self._armed)
+
+    def load(self, armed: dict[str, int]) -> None:
+        """Replace the armed state wholesale (the receiving end of
+        :meth:`snapshot`)."""
+        with self._lock:
+            self._armed = {k: int(v) for k, v in armed.items() if int(v) > 0}
+
+    # -- firing ---------------------------------------------------------------
+
+    def should_fire(self, site: str) -> bool:
+        """Consume one armed count for ``site``; the caller applies the
+        fault itself (drop a frame, corrupt a result)."""
+        with self._lock:
+            remaining = self._armed.get(site, 0)
+            if remaining <= 0:
+                return False
+            if remaining == 1:
+                del self._armed[site]
+            else:
+                self._armed[site] = remaining - 1
+            self._fired[site] = self._fired.get(site, 0) + 1
+            return True
+
+    def fire(self, site: str) -> None:
+        """Raise :class:`FaultInjected` if ``site`` is armed."""
+        if self.should_fire(site):
+            raise FaultInjected(site)
+
+
+#: The process-wide registry every production fault site consults.
+FAULTS = FaultRegistry(env=os.environ)

@@ -46,7 +46,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:  # jax >= 0.6 re-exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # jax 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map
 
 from ..engine.check import DEFAULT_MAX_DEPTH, CheckEngine
 from ..graph.interior import InteriorGraph, build_interior

@@ -93,3 +93,17 @@ class ErrUnavailable(KetoError):
     status_code = 503
     status = "Service Unavailable"
     grpc_code = "UNAVAILABLE"
+
+
+class ErrResourceExhausted(KetoError):
+    """Load shed: the server chose to reject rather than queue without
+    bound (429 / RESOURCE_EXHAUSTED). Retryable after backoff — handlers
+    attach ``retry_after_s`` as a Retry-After hint."""
+
+    status_code = 429
+    status = "Too Many Requests"
+    grpc_code = "RESOURCE_EXHAUSTED"
+    retry_after_s = 1
+
+    def default_message(self) -> str:
+        return "The server is overloaded; retry with backoff."

@@ -11,21 +11,34 @@ import yaml
 from .errors import ErrMalformedInput
 
 
+def _load_toml(path: str, text: str):
+    # tomllib is stdlib only from 3.11; don't let its absence break the
+    # yaml/json formats everyone actually uses on older interpreters
+    try:
+        import tomllib
+    except ImportError as e:
+        raise ErrMalformedInput(
+            f"cannot parse {path}: TOML support requires Python >= 3.11 "
+            "(tomllib)"
+        ) from e
+    try:
+        return tomllib.loads(text)
+    except tomllib.TOMLDecodeError as e:
+        raise ErrMalformedInput(f"cannot parse {path}: {e}") from e
+
+
 def load_structured_file(path: str):
     """Every parser failure surfaces as ErrMalformedInput so callers handle
     one exception type regardless of format."""
-    import tomllib
-
     with open(path) as f:
         text = f.read()
+    if path.endswith(".toml"):
+        return _load_toml(path, text)
     try:
-        if path.endswith((".yaml", ".yml")):
-            return yaml.safe_load(text)
         if path.endswith(".json"):
             return json.loads(text)
-        if path.endswith(".toml"):
-            return tomllib.loads(text)
-        # YAML is a JSON superset: sensible default for extensionless files
+        # yaml/yml, and YAML is a JSON superset: sensible default for
+        # extensionless files
         return yaml.safe_load(text)
-    except (yaml.YAMLError, json.JSONDecodeError, tomllib.TOMLDecodeError) as e:
+    except (yaml.YAMLError, json.JSONDecodeError) as e:
         raise ErrMalformedInput(f"cannot parse {path}: {e}") from e

@@ -159,12 +159,14 @@ def test_toobig_fallback_answers_stamp_live_version():
 def test_closed_batcher_refuses_even_cached_keys():
     import pytest
 
+    from keto_tpu.engine.batcher import BatcherClosed
+
     reg = new_test_registry(namespaces=("videos",))
     reg.store().write_relation_tuples(t("videos:o#r@alice"))
     checker = reg.checker()
     assert checker.check(t("videos:o#r@alice"), 0) is True
     reg._batcher.close()
-    with pytest.raises(RuntimeError):
+    with pytest.raises(BatcherClosed):
         checker.check(t("videos:o#r@alice"), 0)
 
 

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Repo check: the gates a change must pass before review.
+#
+#   1. import hygiene — every keto_tpu module imports (catches moved
+#      upstream APIs like the jax shard_map relocation at CI time)
+#   2. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
+#
+# Usage: bash tools/check.sh            (from the repo root)
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== import hygiene =="
+JAX_PLATFORMS=cpu python tools/verify_imports.py || exit 1
+
+echo "== tier-1 tests =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

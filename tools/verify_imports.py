@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Import every keto_tpu module and fail fast on any ImportError.
+
+Moved/renamed upstream APIs (the `jax.shard_map` -> `jax.experimental.
+shard_map` relocation that silently broke collection of two sharded test
+modules) only surface when the module is actually imported — and modules
+imported lazily (inside functions, behind config flags) can hide breakage
+past the whole test suite. This walks the package tree and imports
+everything, so a stale import is one cheap CI step instead of an
+in-production surprise.
+
+Exit status: 0 when every module imports, 1 otherwise (each failure is
+listed with its originating exception). Modules whose dependencies are
+legitimately absent in a build (optional extras) should guard the import
+themselves — that is the contract this script enforces.
+
+Usage: JAX_PLATFORMS=cpu python tools/verify_imports.py [package]
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import pkgutil
+import sys
+import traceback
+
+# runnable from anywhere: the repo root is this script's parent dir
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def verify(package_name: str = "keto_tpu") -> int:
+    root = importlib.import_module(package_name)
+    failures: list[tuple[str, str]] = []
+    count = 1
+    for info in pkgutil.walk_packages(root.__path__, prefix=f"{package_name}."):
+        count += 1
+        try:
+            importlib.import_module(info.name)
+        except BaseException:
+            failures.append((info.name, traceback.format_exc()))
+    if failures:
+        for name, tb in failures:
+            print(f"FAIL {name}\n{tb}", file=sys.stderr)
+        print(
+            f"{len(failures)}/{count} modules failed to import",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"ok: {count} modules import cleanly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(verify(sys.argv[1] if len(sys.argv) > 1 else "keto_tpu"))
