@@ -29,7 +29,16 @@ check RPCs/sec (the reference publishes no measured numbers — SURVEY.md §6).
 Env knobs: BENCH_CONFIGS (csv; default "rbac1m,github10m,rbac100m"),
 BENCH_BATCH (default 4096), BENCH_ITERS (default 30), BENCH_ENGINE
 (closure|device, default closure), BENCH_SERVER (default 1),
-BENCH_SERVER_SECONDS (default 8).
+BENCH_SERVER_SECONDS (default 8), BENCH_BUDGET_S (default 2400: phases
+that would start past the deadline are skipped — with a logged skip
+line — so the summary JSON always lands before any outer timeout),
+BENCH_POOL_CACHE_DIR (default <repo>/.bench-cache: generated stores are
+cached to .npz and reloaded on the next run), BENCH_PROBE_TIMEOUT_S
+(default 30) / BENCH_PROBE_TTL_S (default 3600: backend-probe verdict
+cached to disk).
+
+``--smoke`` runs a seconds-scale end-to-end pass (tiny config, short
+server leg) — the CI gate wired into tools/check.sh.
 """
 
 from __future__ import annotations
@@ -67,6 +76,130 @@ def _rss_gb() -> float:
 
 
 # ---------------------------------------------------------------------------
+# run budget: the whole ladder races ONE wall-clock deadline. Phases check
+# it before starting; a phase that would begin past the deadline is skipped
+# with a logged line instead of letting an outer `timeout` kill the run
+# mid-phase with no summary (BENCH_r05 ended rc=124 for exactly this).
+# ---------------------------------------------------------------------------
+
+_T_START = time.monotonic()
+
+
+def _budget_left() -> float:
+    return float(os.environ.get("BENCH_BUDGET_S", 2400)) - (
+        time.monotonic() - _T_START
+    )
+
+
+def _skip_phase(phase_name: str, need_s: float = 0.0) -> bool:
+    """True when the remaining budget can't cover `need_s` more seconds;
+    logs the skip so missing numbers are explained, not mysterious."""
+    left = _budget_left()
+    if left > need_s:
+        return False
+    print(
+        json.dumps(
+            {
+                "phase": phase_name,
+                "skipped": "budget",
+                "budget_left_s": round(left, 1),
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+    return True
+
+
+# ---------------------------------------------------------------------------
+# pool cache: generating + interning a 10M–100M-tuple synthetic store costs
+# minutes per run. The post-generation store state is tiny relative to that
+# — vocab keys + the src/dst edge columns — so it round-trips through one
+# .npz keyed by (generator, size, seed, generator version) and reloads in
+# seconds. String pools / derived columns / key chunks all rebuild lazily
+# or cheaply on load, exactly as after a real bulk_load_edges.
+# ---------------------------------------------------------------------------
+
+_GEN_VERSION = 1  # bump when generator logic changes: invalidates the cache
+_KEY_SEP = "\x1f"  # intra-key part separator (never occurs in synthetic keys)
+_REC_SEP = "\x1e"  # inter-key record separator
+
+
+def _pool_cache_path(tag: str, n_tuples: int) -> str:
+    import hashlib
+
+    d = os.environ.get(
+        "BENCH_POOL_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench-cache"),
+    )
+    h = hashlib.sha256(
+        f"{tag}:{n_tuples}:seed=7:gv={_GEN_VERSION}".encode()
+    ).hexdigest()[:16]
+    return os.path.join(d, f"pool_{tag}_{n_tuples}_{h}.npz")
+
+
+def _pool_cache_save(tag: str, n_tuples: int, store) -> None:
+    try:
+        path = _pool_cache_path(tag, n_tuples)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        n = store._n
+        blob = _REC_SEP.join(
+            _KEY_SEP.join(k) for k in store.vocab._key_of
+        ).encode()
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(
+                f,
+                keys=np.frombuffer(blob, dtype=np.uint8),
+                src=store._cols["src_node"][:n],
+                dst=store._cols["dst_node"][:n],
+            )
+        os.replace(tmp, path)
+        _phase(f"pool cache saved: {path} ({os.path.getsize(path)>>20}MB)")
+    except Exception as e:  # cache is an accelerant, never a failure mode
+        _phase(f"pool cache save failed: {e!r}")
+
+
+def _pool_cache_load(tag: str, n_tuples: int):
+    """Rebuild a ColumnarTupleStore from the cache, or None on miss."""
+    path = _pool_cache_path(tag, n_tuples)
+    if not os.path.exists(path):
+        return None
+    try:
+        from keto_tpu.store import ColumnarTupleStore
+
+        z = np.load(path, allow_pickle=False)
+        key_of = [
+            tuple(rec.split(_KEY_SEP))
+            for rec in z["keys"].tobytes().decode().split(_REC_SEP)
+        ]
+        src = np.ascontiguousarray(z["src"], dtype=np.int32)
+        dst = np.ascontiguousarray(z["dst"], dtype=np.int32)
+        store = ColumnarTupleStore()
+        v = store.vocab
+        v._key_of = key_of
+        v._id_of = dict(zip(key_of, range(len(key_of))))
+        n = len(src)
+        store._ensure_capacity(n)
+        c = store._cols
+        c["src_node"][:n] = src
+        c["dst_node"][:n] = dst
+        c["alive"][:n] = True
+        # one sorted key chunk = what a single dedup'd bulk load leaves
+        keys64 = (src.astype(np.int64) << 32) | dst.astype(np.int64)
+        order = np.argsort(keys64)
+        store._key_chunks.append((keys64[order], order.astype(np.int64)))
+        store._n = n
+        store._live = n
+        store._version = 1
+        _phase(f"pool cache hit: {path} ({n} edges)")
+        return store
+    except Exception as e:
+        _phase(f"pool cache load failed (regenerating): {e!r}")
+        return None
+
+
+# ---------------------------------------------------------------------------
 # graph generators (columnar bulk: node-key pools, no tuple objects)
 # ---------------------------------------------------------------------------
 
@@ -90,45 +223,51 @@ def gen_rbac(n_tuples: int, rng: np.random.Generator):
     roles = _pool([("rbac", f"role{i}", "member") for i in range(n_roles)])
     resources = _pool([("rbac", f"res{i}", "view") for i in range(n_resources)])
 
-    store = ColumnarTupleStore()
+    # cached store: on a hit the rng skips the generation draws, so the
+    # sampled workload below differs run-to-run in VALUES but not in
+    # distribution — fine for a throughput benchmark
+    store = _pool_cache_load("rbac", n_tuples)
+    if store is None:
+        store = ColumnarTupleStore()
 
-    def load(src_arr, dst_arr):
-        for i in range(0, len(src_arr), _CHUNK_LOAD):
-            store.bulk_load_edges(
-                src_arr[i : i + _CHUNK_LOAD].tolist(),
-                dst_arr[i : i + _CHUNK_LOAD].tolist(),
-            )
+        def load(src_arr, dst_arr):
+            for i in range(0, len(src_arr), _CHUNK_LOAD):
+                store.bulk_load_edges(
+                    src_arr[i : i + _CHUNK_LOAD].tolist(),
+                    dst_arr[i : i + _CHUNK_LOAD].tolist(),
+                )
 
-    # users -> groups (~40%)
-    k = int(n_tuples * 0.4)
-    _phase(f"rbac membership edges: {k}")
-    load(
-        groups[rng.integers(n_groups, size=k)],
-        users[rng.integers(n_users, size=k)],
-    )
-    # groups -> roles (~10%)
-    k = int(n_tuples * 0.1)
-    _phase(f"rbac group->role edges: {k}")
-    load(
-        roles[rng.integers(n_roles, size=k)],
-        groups[rng.integers(n_groups, size=k)],
-    )
-    # role hierarchy (~5%, naturally collision-capped at small role counts)
-    k = min(int(n_tuples * 0.05), n_roles * n_roles // 2)
-    load(
-        roles[rng.integers(n_roles, size=k)],
-        roles[rng.integers(n_roles, size=k)],
-    )
-    # resource grants -> roles or groups (rest; top up collision losses so
-    # the store really holds >= n_tuples live tuples)
-    grant_dst = _pool(list(roles) + list(groups))
-    while len(store) < n_tuples:
-        k = n_tuples - len(store)
-        _phase(f"rbac grant edges: {k} (live={len(store)})")
+        # users -> groups (~40%)
+        k = int(n_tuples * 0.4)
+        _phase(f"rbac membership edges: {k}")
         load(
-            resources[rng.integers(n_resources, size=k)],
-            grant_dst[rng.integers(len(grant_dst), size=k)],
+            groups[rng.integers(n_groups, size=k)],
+            users[rng.integers(n_users, size=k)],
         )
+        # groups -> roles (~10%)
+        k = int(n_tuples * 0.1)
+        _phase(f"rbac group->role edges: {k}")
+        load(
+            roles[rng.integers(n_roles, size=k)],
+            groups[rng.integers(n_groups, size=k)],
+        )
+        # role hierarchy (~5%, naturally collision-capped at small role counts)
+        k = min(int(n_tuples * 0.05), n_roles * n_roles // 2)
+        load(
+            roles[rng.integers(n_roles, size=k)],
+            roles[rng.integers(n_roles, size=k)],
+        )
+        # resource grants -> roles or groups (rest; top up collision losses so
+        # the store really holds >= n_tuples live tuples)
+        grant_dst = _pool(list(roles) + list(groups))
+        while len(store) < n_tuples:
+            k = n_tuples - len(store)
+            _phase(f"rbac grant edges: {k} (live={len(store)})")
+            load(
+                resources[rng.integers(n_resources, size=k)],
+                grant_dst[rng.integers(len(grant_dst), size=k)],
+            )
+        _pool_cache_save("rbac", n_tuples, store)
 
     def sample(rng, k):
         s = [resources[i] for i in rng.integers(n_resources, size=k)]
@@ -155,38 +294,43 @@ def gen_github(n_tuples: int, rng: np.random.Generator):
         [("gh", f"repo{i}", p) for i in range(n_repos) for p in perms]
     )
 
-    store = ColumnarTupleStore()
+    # cached store: same rng caveat as gen_rbac — a hit changes the sampled
+    # workload's values, not its distribution
+    store = _pool_cache_load("github", n_tuples)
+    if store is None:
+        store = ColumnarTupleStore()
 
-    def load(src_arr, dst_arr):
-        for i in range(0, len(src_arr), _CHUNK_LOAD):
-            store.bulk_load_edges(
-                src_arr[i : i + _CHUNK_LOAD].tolist(),
-                dst_arr[i : i + _CHUNK_LOAD].tolist(),
-            )
+        def load(src_arr, dst_arr):
+            for i in range(0, len(src_arr), _CHUNK_LOAD):
+                store.bulk_load_edges(
+                    src_arr[i : i + _CHUNK_LOAD].tolist(),
+                    dst_arr[i : i + _CHUNK_LOAD].tolist(),
+                )
 
-    # team membership (~45%)
-    k = int(n_tuples * 0.45)
-    load(
-        teams[rng.integers(n_teams, size=k)],
-        users[rng.integers(n_users, size=k)],
-    )
-    # team nesting (~3%)
-    k = int(n_tuples * 0.03)
-    load(
-        teams[rng.integers(n_teams, size=k)],
-        teams[rng.integers(n_teams, size=k)],
-    )
-    # repo permission grants (rest): 80% to teams, 20% direct collaborators;
-    # top up collision losses
-    while len(store) < n_tuples:
-        k = n_tuples - len(store)
-        to_team = rng.random(k) < 0.8
-        dst = np.where(
-            to_team,
+        # team membership (~45%)
+        k = int(n_tuples * 0.45)
+        load(
             teams[rng.integers(n_teams, size=k)],
             users[rng.integers(n_users, size=k)],
         )
-        load(repo_perm[rng.integers(len(repo_perm), size=k)], _as_obj(dst))
+        # team nesting (~3%)
+        k = int(n_tuples * 0.03)
+        load(
+            teams[rng.integers(n_teams, size=k)],
+            teams[rng.integers(n_teams, size=k)],
+        )
+        # repo permission grants (rest): 80% to teams, 20% direct
+        # collaborators; top up collision losses
+        while len(store) < n_tuples:
+            k = n_tuples - len(store)
+            to_team = rng.random(k) < 0.8
+            dst = np.where(
+                to_team,
+                teams[rng.integers(n_teams, size=k)],
+                users[rng.integers(n_users, size=k)],
+            )
+            load(repo_perm[rng.integers(len(repo_perm), size=k)], _as_obj(dst))
+        _pool_cache_save("github", n_tuples, store)
 
     pull_perms = _pool([("gh", f"repo{i}", "pull") for i in range(n_repos)])
 
@@ -333,6 +477,7 @@ def _run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_ki
         os.environ.get("BENCH_DEVICE_LEG", "1") == "1"
         and hasattr(engine, "device_view")
         and isinstance(getattr(engine, "_state", None), _ClosureArtifacts)
+        and not _skip_phase(f"{name}:device_leg", 30.0)
     ):
         try:
             dview = engine.device_view()
@@ -414,14 +559,18 @@ def _run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_ki
         meta["freshness"] = engine.freshness
     print(json.dumps(meta), file=sys.stderr, flush=True)
 
-    if os.environ.get("BENCH_WRITES", "1") == "1" and hasattr(
-        engine, "wait_for_version"
+    if (
+        os.environ.get("BENCH_WRITES", "1") == "1"
+        and hasattr(engine, "wait_for_version")
+        and not _skip_phase(f"{name}:writes", 60.0)
     ):
         writes_meta = run_write_bench(name, store, engine, sample, to_requests)
         meta.update(writes_meta)
         print(json.dumps(writes_meta), file=sys.stderr, flush=True)
 
-    if os.environ.get("BENCH_SERVER", "1") == "1":
+    if os.environ.get("BENCH_SERVER", "1") == "1" and not _skip_phase(
+        f"{name}:server", 90.0
+    ):
         server_meta = run_server_bench(
             name, store, snapshots, engine, sample, to_requests
         )
@@ -949,10 +1098,31 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
 
 
 CONFIGS = {
+    "smoke": (50_000, gen_rbac),  # --smoke / CI gate scale
     "rbac1m": (1_000_000, gen_rbac),
     "github10m": (10_000_000, gen_github),
     "rbac100m": (100_000_000, gen_rbac),
 }
+
+
+def _smoke_defaults() -> None:
+    """--smoke: a seconds-scale end-to-end pass over the full serving path
+    (tiny config, short server leg) — the tools/check.sh gate. Every knob
+    is a setdefault, so explicit env still wins."""
+    for k, v in {
+        "BENCH_CONFIGS": "smoke",
+        "BENCH_BATCH": "256",
+        "BENCH_ITERS": "5",
+        "BENCH_SERVER_SECONDS": "2",
+        "BENCH_SERVER_THREADS": "2",
+        "BENCH_SERVER_PROCS": "1",
+        "BENCH_SERVER_WORKERS": "2",
+        "BENCH_WRITE_CYCLES": "3",
+        "BENCH_SHARDED": "0",
+        "BENCH_BUDGET_S": "240",
+        "BENCH_PROBE_TIMEOUT_S": "20",
+    }.items():
+        os.environ.setdefault(k, v)
 
 
 # ---------------------------------------------------------------------------
@@ -1103,7 +1273,7 @@ def run_sharded_bench():
         cwd=repo,
         capture_output=True,
         text=True,
-        timeout=1200,
+        timeout=min(1200.0, max(60.0, _budget_left())),
     )
     for line in proc.stdout.splitlines():
         if line.startswith("{"):
@@ -1114,6 +1284,43 @@ def run_sharded_bench():
             f"{proc.stderr[-1000:]}",
             file=sys.stderr,
         )
+
+
+def _probe_cache_path() -> str:
+    d = os.environ.get(
+        "BENCH_POOL_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench-cache"),
+    )
+    return os.path.join(d, "backend_probe.json")
+
+
+def _probe_cache_read() -> tuple[str | None, str | None] | None:
+    """Cached (platform, error) verdict, or None when absent/expired. A
+    sick chip hangs the probe for the full timeout EVERY run; the verdict
+    rarely changes within an hour, so it is paid once per TTL."""
+    try:
+        with open(_probe_cache_path()) as f:
+            v = json.load(f)
+        ttl = float(os.environ.get("BENCH_PROBE_TTL_S", 3600))
+        if time.time() - float(v["t"]) > ttl:
+            return None
+        return v.get("platform"), v.get("error")
+    except Exception:
+        return None
+
+
+def _probe_cache_write(platform: str | None, error: str | None) -> None:
+    try:
+        path = _probe_cache_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"platform": platform, "error": error, "t": time.time()}, f
+            )
+        os.replace(tmp, path)
+    except Exception:
+        pass  # a cache-write failure only costs the next run a re-probe
 
 
 def _probe_backend(timeout_s: float) -> tuple[str | None, str | None]:
@@ -1145,6 +1352,8 @@ def _probe_backend(timeout_s: float) -> tuple[str | None, str | None]:
 
 
 def main():
+    if "--smoke" in sys.argv:
+        _smoke_defaults()  # also re-applied after a cpu-fallback re-exec
     # --- backend guard (before ANY in-process jax import) ---------------
     # A sick chip must degrade the number, not the run: on probe failure,
     # RE-EXEC this interpreter with a clean CPU env and keep going — the
@@ -1161,9 +1370,17 @@ def main():
         }
         print(json.dumps(backend_meta), file=sys.stderr, flush=True)
     else:
-        platform, tpu_error = _probe_backend(
-            float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 180))
-        )
+        cached = _probe_cache_read()
+        if cached is not None:
+            platform, tpu_error = cached
+        else:
+            # 30s default (was 180): a healthy backend answers in seconds;
+            # a sick one hangs forever — r05 burned 3 minutes learning
+            # nothing new. Verdict cached across runs (BENCH_PROBE_TTL_S).
+            platform, tpu_error = _probe_backend(
+                float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 30))
+            )
+            _probe_cache_write(platform, tpu_error)
         if tpu_error is not None:
             from __graft_entry__ import cpu_fallback_env
 
@@ -1231,6 +1448,10 @@ def main():
             )
             continue
         n, gen = CONFIGS[name]
+        # a config whose build alone would blow the remaining budget is
+        # skipped whole — the summary line for completed configs still lands
+        if _skip_phase(f"config:{name}", 120.0):
+            continue
         try:
             results.append(
                 run_config(name, n, gen, batch, iters, engine_kind)
@@ -1252,7 +1473,9 @@ def main():
         # a valid result for the largest completed config
         _print_primary(results, backend_meta)
 
-    if os.environ.get("BENCH_SHARDED", "1") == "1":
+    if os.environ.get("BENCH_SHARDED", "1") == "1" and not _skip_phase(
+        "sharded", 120.0
+    ):
         try:
             run_sharded_bench()
         except Exception as e:

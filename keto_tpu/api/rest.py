@@ -259,6 +259,15 @@ class ReadAPI:
         app.router.add_post(ROUTE_CHECK, self.post_check)
         app.router.add_post(ROUTE_CHECK_BATCH, self.post_check_batch)
         app.router.add_get(ROUTE_EXPAND, self.get_expand)
+        app.router.add_get("/pipeline", self.get_pipeline)
+
+    async def get_pipeline(self, request: web.Request) -> web.Response:
+        """keto_tpu extension: dispatch-pipeline occupancy (queue depths,
+        stage layout, in-flight batches) as one JSON object — the
+        quick-look twin of the keto_pipeline_* series on /metrics."""
+        stats_fn = getattr(self.checker, "pipeline_stats", None)
+        stats = stats_fn() if callable(stats_fn) else {"pipelined": False}
+        return web.json_response(stats)
 
     async def get_relations(self, request: web.Request) -> web.Response:
         p = request.rel_url.query

@@ -69,6 +69,13 @@ class CheckServicer:
         self.checker = checker
         self.snaptoken_fn = snaptoken_fn
 
+    def pipeline_stats(self) -> dict:
+        """Dispatch-pipeline occupancy of the backing checker (queue
+        depths, in-flight batches). The REST twin serves this at
+        /pipeline; here it is an accessor for the process supervisor."""
+        fn = getattr(self.checker, "pipeline_stats", None)
+        return fn() if callable(fn) else {"pipelined": False}
+
     def Check(self, request, context):
         try:
             subject = subject_from_proto(
@@ -537,3 +544,8 @@ class _DirectChecker:
         return dispatch_batched(
             self.engine, requests, max_depth, self.max_batch
         )
+
+    def pipeline_stats(self) -> dict:
+        # same shape the CheckBatcher reports, so /pipeline and the gRPC
+        # accessor work uniformly over either checker
+        return {"pipelined": False, "queue_depth": 0, "max_batch": self.max_batch}

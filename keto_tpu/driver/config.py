@@ -177,6 +177,16 @@ CONFIG_SCHEMA = {
                 # dispatch queue bound before the batcher sheds load with
                 # 429/RESOURCE_EXHAUSTED (0 = 8 * max_batch)
                 "max_queue": {"type": "integer", "minimum": 0},
+                # pipelined check dispatch (engine/batcher.py): batches in
+                # flight on device (0 = serial, one batch at a time); only
+                # engines with the split encode/launch/decode API pipeline —
+                # others silently keep the serial loop
+                "pipeline_depth": {"type": "integer", "minimum": 0},
+                # host threads vocab-encoding queued requests into batches
+                "encode_workers": {"type": "integer", "minimum": 1},
+                # snapshot-versioned encoded-request cache in front of the
+                # device stage, keyed (start, target, depth) ids (0 disables)
+                "encoded_cache_size": {"type": "integer", "minimum": 0},
                 # device-engine circuit breaker -> host-oracle fallback
                 "fallback": {"type": "boolean"},
                 "fallback_threshold": {"type": "integer", "minimum": 1},
@@ -220,6 +230,9 @@ DEFAULTS = {
     "engine.rebuild_debounce_ms": 50,
     "engine.cache_size": 65536,
     "engine.max_queue": 0,
+    "engine.pipeline_depth": 2,
+    "engine.encode_workers": 2,
+    "engine.encoded_cache_size": 65536,
     "engine.fallback": True,
     "engine.fallback_threshold": 3,
     "engine.fallback_cooldown_ms": 1000,

@@ -320,6 +320,17 @@ class Registry:
                         self.config.get("engine.max_queue", default=0)
                     ),
                     logger=self.logger(),
+                    pipeline_depth=int(
+                        self.config.get("engine.pipeline_depth", default=2)
+                    ),
+                    encode_workers=int(
+                        self.config.get("engine.encode_workers", default=2)
+                    ),
+                    encoded_cache_size=int(
+                        self.config.get(
+                            "engine.encoded_cache_size", default=65536
+                        )
+                    ),
                 )
                 self._checker = self._batcher
         return self._checker

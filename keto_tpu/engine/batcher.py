@@ -3,19 +3,34 @@
 The reference runs one goroutine per request, each walking the graph alone
 (SURVEY.md §2.10). On TPU the economics invert: one batched frontier
 expansion amortizes kernel launch and HBM traffic over every in-flight
-request. The batcher is that seam: callers block on ``check()``, a dispatcher
-thread drains the queue into one ``DeviceCheckEngine.batch_check`` call —
+request. The batcher is that seam: callers block on ``check()``, and the
+dispatch machinery drains the queue into ``DeviceCheckEngine`` batches —
 taking whatever has accumulated while the previous batch was on device (the
 natural batching window), plus a tiny fixed window when the queue is empty.
 
-Because every caller funnels through ONE dispatcher thread, that thread is
-shared-fate for the whole read plane — so it is supervised:
+Two dispatch shapes share this class:
 
-- **watchdog**: if the dispatcher dies outside the per-batch engine guard
-  (a bug, an injected ``batcher.dispatcher_die`` fault), the guard fails
-  the in-flight batch with :class:`DispatcherCrashed` and restarts the
-  thread; queued-but-undispatched requests survive and are answered by the
-  replacement.
+- **serial** (``pipeline_depth=0``, or an engine without the split
+  encode/launch/decode API): one dispatcher thread runs vocab-encode ->
+  upload -> execute -> decode strictly in order, one batch in flight.
+- **pipelined** (``pipeline_depth>=1`` and a capable engine): a bounded
+  multi-stage pipeline. Encode workers drain the queue and vocab-encode on
+  host threads; a launch thread enqueues kernels back-to-back (JAX async
+  dispatch returns at enqueue, so up to ``pipeline_depth`` batches are in
+  flight on device); a decode thread materializes results and resolves
+  caller futures off the critical path. An optional snapshot-versioned
+  encoded-request cache sits in front of the device stage: rows whose
+  (start, target, depth) triple was answered at this snapshot version skip
+  the kernel entirely.
+
+Because callers funnel through shared-fate stage threads, every stage is
+supervised the same way the PR-1 dispatcher was:
+
+- **watchdog**: a stage thread death (a bug, an injected
+  ``batcher.dispatcher_die``/``batcher.encode_die``/``batcher.decode_die``
+  fault) fails exactly the batch that stage held with
+  :class:`DispatcherCrashed` (typed, retryable) and restarts the stage;
+  queued requests and batches held by other stages survive.
 - **bounded queue**: past ``max_queue`` waiting requests the batcher sheds
   load with :class:`BatcherOverloaded` (HTTP 429 / gRPC RESOURCE_EXHAUSTED
   at the transports) instead of growing the queue — and the latency of
@@ -23,10 +38,16 @@ shared-fate for the whole read plane — so it is supervised:
 - **typed shutdown**: after ``close()`` no caller can hang past the join
   budget; anything still queued or in flight fails with
   :class:`BatcherClosed`.
+
+Observability: per-stage latency histograms
+(``keto_pipeline_stage_seconds{stage=enqueue|encode|launch|device|decode}``)
+plus launch/decode queue-depth gauges — see telemetry/metrics.py
+(PIPELINE_STAGES) and docs/guides/performance.md for how to read them.
 """
 
 from __future__ import annotations
 
+import queue as _queue_mod
 import threading
 import time
 from concurrent.futures import Future
@@ -34,6 +55,7 @@ from typing import Optional, Sequence
 
 from ..faults import FAULTS
 from ..relationtuple.definitions import RelationTuple
+from ..telemetry.metrics import pipeline_stage_histogram
 from ..utils.errors import ErrInternal, ErrResourceExhausted, ErrUnavailable
 
 
@@ -53,11 +75,40 @@ class BatcherOverloaded(ErrResourceExhausted):
 
 
 class DispatcherCrashed(ErrInternal):
-    """The dispatcher thread died while this request was in flight; the
+    """A dispatch stage thread died while this request was in flight; the
     watchdog restarted it. The request was NOT answered — retryable."""
 
     def default_message(self) -> str:
         return "The check dispatcher crashed mid-batch and was restarted."
+
+
+# close()/clean-shutdown marker passed down the stage queues
+_SENTINEL = object()
+
+
+class _PBatch:
+    """One batch moving through the pipeline: queue items plus per-stage
+    artifacts and timestamps."""
+
+    __slots__ = ("items", "enc", "launched", "keys", "t_encoded")
+
+    def __init__(self, items):
+        self.items = items  # [(request, depth, Future, t_enqueued), ...]
+        self.enc = None  # EncodedBatch after the encode stage
+        self.launched = None  # LaunchedBatch after the launch stage
+        self.keys = None  # encoded-cache keys (when the cache is on)
+        self.t_encoded = 0.0
+
+
+class _Holder:
+    """The batch a stage loop currently owns — what its watchdog fails on
+    a crash. Ownership passes to the next queue the moment the loop clears
+    the holder, so exactly one owner exists at any time."""
+
+    __slots__ = ("batch",)
+
+    def __init__(self):
+        self.batch = None
 
 
 class CheckBatcher:
@@ -72,6 +123,9 @@ class CheckBatcher:
         # (engine.answering_version — not served_version, which lags writes)
         max_queue: int = 0,  # 0 -> 8 * max_batch
         logger=None,
+        pipeline_depth: int = 0,  # 0 -> serial dispatch (one batch in flight)
+        encode_workers: int = 2,
+        encoded_cache_size: int = 0,  # 0 disables the encoded-request cache
     ):
         self.engine = engine
         self.max_batch = max_batch
@@ -80,9 +134,28 @@ class CheckBatcher:
         self.version_fn = version_fn
         self.max_queue = max_queue if max_queue > 0 else 8 * max_batch
         self._logger = logger
+        self.pipeline_depth = pipeline_depth
+        self.encode_workers = max(1, encode_workers)
+        # pipelining needs the engine's split encode/launch/decode API;
+        # engines without it (host oracle, closure) keep the serial loop
+        sup = getattr(engine, "pipeline_supported", None)
+        capable = (
+            sup()
+            if callable(sup)
+            else callable(getattr(engine, "encode_batch", None))
+        )
+        self.pipelined = pipeline_depth >= 1 and capable
+        self.encoded_cache = None
+        if self.pipelined and encoded_cache_size > 0:
+            from .cache import CheckResultCache
+
+            self.encoded_cache = CheckResultCache(
+                encoded_cache_size, metrics, name="encoded"
+            )
         self._m_batch_size = None
         self._m_shed = None
         self._m_restarts = None
+        self._m_stage = None
         if metrics is not None:
             self._m_batch_size = metrics.histogram(
                 "keto_batcher_batch_size",
@@ -95,25 +168,57 @@ class CheckBatcher:
             )
             self._m_restarts = metrics.counter(
                 "keto_batcher_dispatcher_restarts_total",
-                "dispatcher thread deaths recovered by the watchdog",
+                "dispatch stage thread deaths recovered by the watchdog",
             )
             metrics.gauge(
                 "keto_batcher_queue_depth",
                 "check requests waiting for dispatch",
                 fn=lambda: len(self._queue),
             )
+            if self.pipelined:
+                self._m_stage = pipeline_stage_histogram(metrics)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._queue: list[tuple[RelationTuple, int, Future]] = []
-        # the batch the dispatcher popped but has not answered yet — the
-        # watchdog fails exactly these on a dispatcher death, and close()
-        # fails them after the join budget
-        self._inflight: list[tuple[RelationTuple, int, Future]] = []
+        self._queue: list[tuple] = []  # (request, depth, Future, t_enqueued)
+        # serial mode: the batch the dispatcher popped but has not answered
+        # yet — the watchdog fails exactly these on a dispatcher death, and
+        # close() fails them after the join budget
+        self._inflight: list[tuple] = []
+        # pipelined mode: every batch admitted to the pipeline and not yet
+        # resolved, whichever stage or queue currently owns it — close()
+        # fails the stragglers after the join budget
+        self._pipe_batches: dict[int, _PBatch] = {}
         self._closed = False
         # close() lets the dispatcher drain for this long before failing
         # the leftovers typed; only a wedged engine ever exhausts it
         self.close_join_s = 5.0
-        self._thread = self._spawn_dispatcher()
+        if self.pipelined:
+            # launch_q admits roughly one encoded batch per encode worker;
+            # decode_q is the in-flight bound: the launch thread blocks
+            # putting batch N+pipeline_depth until batch N is materialized
+            self._launch_q: _queue_mod.Queue = _queue_mod.Queue(
+                maxsize=max(2, self.encode_workers)
+            )
+            self._decode_q: _queue_mod.Queue = _queue_mod.Queue(
+                maxsize=max(1, pipeline_depth)
+            )
+            self._encoders_live = self.encode_workers
+            if metrics is not None:
+                metrics.gauge(
+                    "keto_pipeline_launch_queue_depth",
+                    "encoded batches waiting for kernel dispatch",
+                    fn=self._launch_q.qsize,
+                )
+                metrics.gauge(
+                    "keto_pipeline_decode_queue_depth",
+                    "launched batches in flight awaiting decode",
+                    fn=self._decode_q.qsize,
+                )
+            self._threads = self._spawn_pipeline()
+            self._thread = self._threads[0]  # close()/tests compatibility
+        else:
+            self._thread = self._spawn_dispatcher()
+            self._threads = [self._thread]
 
     def _spawn_dispatcher(self) -> threading.Thread:
         t = threading.Thread(
@@ -121,6 +226,36 @@ class CheckBatcher:
         )
         t.start()
         return t
+
+    def _spawn_pipeline(self) -> list[threading.Thread]:
+        threads = []
+        for i in range(self.encode_workers):
+            threads.append(
+                threading.Thread(
+                    target=self._encode_guard,
+                    name=f"check-encode-{i}",
+                    daemon=True,
+                )
+            )
+        threads.append(
+            threading.Thread(
+                target=self._stage_guard,
+                args=(self._launch_loop, "launch"),
+                name="check-launch",
+                daemon=True,
+            )
+        )
+        threads.append(
+            threading.Thread(
+                target=self._stage_guard,
+                args=(self._decode_loop, "decode"),
+                name="check-decode",
+                daemon=True,
+            )
+        )
+        for t in threads:
+            t.start()
+        return threads
 
     def check(
         self,
@@ -159,7 +294,7 @@ class CheckBatcher:
                 if self._m_shed is not None:
                     self._m_shed.inc()
                 raise BatcherOverloaded()
-            self._queue.append((request, max_depth, f))
+            self._queue.append((request, max_depth, f, time.perf_counter()))
             self._cv.notify()
         result = f.result(timeout=timeout)
         if self.cache is not None:
@@ -176,7 +311,10 @@ class CheckBatcher:
         """A caller-assembled batch: already amortized, so it skips the
         queue and dispatches directly (the batch-check transport path).
         `min_version` applies the at-least-as-fresh contract to the whole
-        batch before dispatch, bounded by `timeout` (the RPC deadline)."""
+        batch before dispatch, bounded by `timeout` (the RPC deadline).
+        The result cache is consulted in bulk with the same stamp the
+        single path uses — a hot repeated payload costs dict probes, not
+        an engine dispatch."""
         if self._closed:
             raise BatcherClosed()
         if min_version > 0:
@@ -186,33 +324,108 @@ class CheckBatcher:
                     min_version,
                     timeout_s=timeout if timeout is not None else 30.0,
                 )
-        return dispatch_batched(
-            self.engine, requests, max_depth, self.max_batch
+        if self.cache is None:
+            return dispatch_batched(
+                self.engine, requests, max_depth, self.max_batch
+            )
+        version = self.version_fn()
+        keys = [(r, max_depth) for r in requests]
+        cached = self.cache.get_many(version, keys)
+        miss_idx = [i for i, v in enumerate(cached) if v is None]
+        if not miss_idx:
+            return [bool(v) for v in cached]
+        res = dispatch_batched(
+            self.engine,
+            [requests[i] for i in miss_idx],
+            max_depth,
+            self.max_batch,
         )
+        self.cache.put_many(version, [keys[i] for i in miss_idx], res)
+        out = [None if v is None else bool(v) for v in cached]
+        for i, v in zip(miss_idx, res):
+            out[i] = bool(v)
+        return out
 
     def close(self) -> None:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        # the dispatcher drains the queue before exiting; the join budget
-        # only runs out when the engine itself is wedged (the sick-chip
+        # the stages drain the queue before exiting; the join budget only
+        # runs out when the engine itself is wedged (the sick-chip
         # hang-not-raise mode) — then every waiter is failed typed instead
         # of hanging past shutdown
-        self._thread.join(timeout=self.close_join_s)
+        deadline = time.monotonic() + self.close_join_s
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
         with self._cv:
             leftovers = self._queue + self._inflight
             self._queue = []
             self._inflight = []
-        for _, _, f in leftovers:
+            for b in self._pipe_batches.values():
+                leftovers.extend(b.items)
+            self._pipe_batches = {}
+        for item in leftovers:
+            f = item[2]
             if not f.done():
                 f.set_exception(BatcherClosed())
 
-    # -- dispatcher ----------------------------------------------------------
+    def pipeline_stats(self) -> dict:
+        """Queue/stage occupancy snapshot — surfaced by the read plane's
+        stats endpoints so pipeline health is observable without scraping."""
+        out = {
+            "pipelined": self.pipelined,
+            "queue_depth": len(self._queue),
+            "max_queue": self.max_queue,
+            "max_batch": self.max_batch,
+        }
+        if self.pipelined:
+            with self._lock:
+                inflight = len(self._pipe_batches)
+            out.update(
+                {
+                    "pipeline_depth": self.pipeline_depth,
+                    "encode_workers": self.encode_workers,
+                    "launch_queue_depth": self._launch_q.qsize(),
+                    "decode_queue_depth": self._decode_q.qsize(),
+                    "batches_in_pipeline": inflight,
+                    "encoded_cache_entries": (
+                        len(self.encoded_cache)
+                        if self.encoded_cache is not None
+                        else 0
+                    ),
+                }
+            )
+        return out
 
-    def _drain(self) -> list[tuple[RelationTuple, int, Future]]:
+    # -- shared plumbing -----------------------------------------------------
+
+    def _drain(self) -> list[tuple]:
         batch = self._queue[: self.max_batch]
         del self._queue[: len(batch)]
         return batch
+
+    def _await_work(self) -> Optional[list[tuple]]:
+        """Block for queued requests; returns None on clean shutdown with
+        an empty queue, else the drained batch (after the accumulation
+        window when only one request is waiting)."""
+        with self._cv:
+            while not self._queue and not self._closed:
+                self._cv.wait()
+            if self._closed and not self._queue:
+                return None
+            first_only = len(self._queue) == 1
+        if first_only and self.window_s > 0:
+            # brief accumulation window; under load the device round-trip
+            # itself provides the window and this never triggers
+            time.sleep(self.window_s)
+        with self._cv:
+            return self._drain()
+
+    def _observe(self, stage: str, seconds: float) -> None:
+        if self._m_stage is not None:
+            self._m_stage.labels(stage=stage).observe(seconds)
+
+    # -- serial dispatcher ---------------------------------------------------
 
     def _run_guard(self) -> None:
         """Watchdog shell around the dispatch loop: a dispatcher death must
@@ -228,7 +441,8 @@ class CheckBatcher:
                     inflight = self._inflight
                     self._inflight = []
                     closed = self._closed
-                for _, _, f in inflight:
+                for item in inflight:
+                    f = item[2]
                     if not f.done():
                         f.set_exception(DispatcherCrashed())
                 if self._m_restarts is not None:
@@ -244,21 +458,13 @@ class CheckBatcher:
     def _run(self) -> None:
         while True:
             FAULTS.fire("batcher.dispatcher_die")
-            with self._cv:
-                while not self._queue and not self._closed:
-                    self._cv.wait()
-                if self._closed and not self._queue:
-                    return
-                first_only = len(self._queue) == 1
-            if first_only and self.window_s > 0:
-                # brief accumulation window; under load the device round-trip
-                # itself provides the window and this never triggers
-                time.sleep(self.window_s)
-            with self._cv:
-                batch = self._drain()
-                self._inflight = batch
+            batch = self._await_work()
+            if batch is None:
+                return
             if not batch:
                 continue
+            with self._cv:
+                self._inflight = batch
             if self._m_batch_size is not None:
                 self._m_batch_size.observe(len(batch))
             requests = [b[0] for b in batch]
@@ -266,17 +472,184 @@ class CheckBatcher:
             try:
                 results = self.engine.batch_check(requests, depths=depths)
             except Exception as e:  # propagate to every caller in the batch
-                for _, _, f in batch:
+                for item in batch:
+                    f = item[2]
                     if not f.done():
                         f.set_exception(e)
                 with self._cv:
                     self._inflight = []
                 continue
-            for (_, _, f), allowed in zip(batch, results):
+            for item, allowed in zip(batch, results):
+                f = item[2]
                 if not f.done():
                     f.set_result(bool(allowed))
             with self._cv:
                 self._inflight = []
+
+    # -- pipelined stages ----------------------------------------------------
+
+    def _register(self, batch: _PBatch) -> None:
+        with self._lock:
+            self._pipe_batches[id(batch)] = batch
+
+    def _complete(self, batch: _PBatch) -> None:
+        with self._lock:
+            self._pipe_batches.pop(id(batch), None)
+
+    def _fail_batch(self, batch: _PBatch, exc: BaseException) -> None:
+        self._complete(batch)
+        if batch.enc is not None:
+            batch.enc.release()
+        for item in batch.items:
+            f = item[2]
+            if not f.done():
+                f.set_exception(exc)
+
+    def _stage_guard(self, loop_fn, stage: str) -> None:
+        """Watchdog shell shared by the launch/decode stages (encode adds
+        worker accounting on top): a stage death fails exactly the batch
+        that stage held, typed and retryable, then restarts the stage.
+        Batches owned by the queues or by other stages are untouched."""
+        while True:
+            holder = _Holder()
+            try:
+                loop_fn(holder)
+                return  # clean close
+            except BaseException:
+                batch, holder.batch = holder.batch, None
+                if batch is not None:
+                    self._fail_batch(batch, DispatcherCrashed())
+                if self._m_restarts is not None:
+                    self._m_restarts.inc()
+                if self._logger is not None:
+                    self._logger.warn(
+                        "check pipeline stage died; restarting",
+                        stage=stage,
+                        failed_inflight=0 if batch is None else len(batch.items),
+                    )
+                if self._closed:
+                    return
+
+    def _encode_guard(self) -> None:
+        self._stage_guard(self._encode_loop, "encode")
+        # clean exit: the LAST encode worker out sends the shutdown
+        # sentinel downstream so launch/decode drain and exit in order
+        with self._lock:
+            self._encoders_live -= 1
+            last = self._encoders_live == 0
+        if last:
+            self._launch_q.put(_SENTINEL)
+
+    def _encode_loop(self, holder: _Holder) -> None:
+        while True:
+            items = self._await_work()
+            if items is None:
+                return
+            if not items:
+                continue
+            batch = _PBatch(items)
+            holder.batch = batch
+            self._register(batch)
+            FAULTS.fire("batcher.encode_die")
+            t0 = time.perf_counter()
+            self._observe("enqueue", t0 - min(it[3] for it in items))
+            if self._m_batch_size is not None:
+                self._m_batch_size.observe(len(items))
+            requests = [it[0] for it in items]
+            depths = [it[1] for it in items]
+            try:
+                enc = self.engine.encode_batch(requests, depths=depths)
+            except Exception as e:
+                self._fail_batch(batch, e)
+                holder.batch = None
+                continue
+            batch.enc = enc
+            if self.encoded_cache is not None:
+                # encoded-request cache: rows answered at this snapshot
+                # version resolve here; only the misses ride the kernel
+                keys = enc.keys()
+                cached = self.encoded_cache.get_many(enc.version, keys)
+                miss = [i for i, v in enumerate(cached) if v is None]
+                if len(miss) < len(items):
+                    for i, v in enumerate(cached):
+                        if v is not None:
+                            f = items[i][2]
+                            if not f.done():
+                                f.set_result(bool(v))
+                    if not miss:
+                        enc.release()
+                        self._complete(batch)
+                        holder.batch = None
+                        self._observe("encode", time.perf_counter() - t0)
+                        continue
+                    enc.compact(miss)
+                    batch.items = [items[i] for i in miss]
+                    batch.keys = [keys[i] for i in miss]
+                else:
+                    batch.keys = keys
+            self._observe("encode", time.perf_counter() - t0)
+            batch.t_encoded = time.perf_counter()
+            # ownership passes to the launch queue; bounded put is the
+            # encode stage's backpressure
+            holder.batch = None
+            self._launch_q.put(batch)
+
+    def _launch_loop(self, holder: _Holder) -> None:
+        while True:
+            batch = self._launch_q.get()
+            if batch is _SENTINEL:
+                self._decode_q.put(_SENTINEL)
+                return
+            holder.batch = batch
+            # the device stage inherits the PR-1 dispatcher fault site:
+            # "the dispatcher" is now the thread that talks to the device
+            FAULTS.fire("batcher.dispatcher_die")
+            try:
+                batch.launched = self.engine.launch_encoded(batch.enc)
+            except Exception as e:
+                self._fail_batch(batch, e)
+                holder.batch = None
+                continue
+            # launch = queue wait + kernel enqueue (async dispatch: this
+            # does NOT include device execution, which overlaps the next
+            # batch's encode/launch)
+            self._observe("launch", time.perf_counter() - batch.t_encoded)
+            holder.batch = None
+            # bounded put: blocks once pipeline_depth batches await decode,
+            # which is what caps batches in flight on device
+            self._decode_q.put(batch)
+
+    def _decode_loop(self, holder: _Holder) -> None:
+        while True:
+            batch = self._decode_q.get()
+            if batch is _SENTINEL:
+                return
+            holder.batch = batch
+            FAULTS.fire("batcher.decode_die")
+            t0 = time.perf_counter()
+            try:
+                results = self.engine.decode_launched(batch.launched)
+            except Exception as e:
+                self._fail_batch(batch, e)
+                holder.batch = None
+                continue
+            # device = block-until-materialized; with the pipeline full
+            # this approaches pure device execution time per batch
+            t1 = time.perf_counter()
+            self._observe("device", t1 - t0)
+            for item, allowed in zip(batch.items, results):
+                f = item[2]
+                if not f.done():
+                    f.set_result(bool(allowed))
+            if self.encoded_cache is not None and batch.keys is not None:
+                self.encoded_cache.put_many(
+                    batch.enc.version,
+                    batch.keys,
+                    [bool(v) for v in results],
+                )
+            self._complete(batch)
+            self._observe("decode", time.perf_counter() - t1)
+            holder.batch = None
 
 
 def dispatch_batched(

@@ -13,8 +13,13 @@ old version until the rebuild runs); under bounded freshness it is the
 serving snapshot's version, and asking for it also kicks the background
 rebuild so cache hits cannot starve the freshness machinery. Do NOT stamp
 with served_version: it lags writes under strong freshness and would keep
-returning pre-write answers. Batch paths bypass the cache (they are
-already amortized; per-item lookups would just add lock traffic).
+returning pre-write answers. Batch paths use the bulk entry points
+(``get_many``/``put_many``): one lock acquisition per batch, so a hot
+repeated payload costs dict probes, not engine dispatches.
+
+The same class backs the pipeline's encoded-request cache (keys are
+(start, target, depth) id triples instead of request tuples) — pass
+``name`` so the two caches report distinct hit/miss counters.
 """
 
 from __future__ import annotations
@@ -25,17 +30,19 @@ from typing import Hashable, Optional
 
 
 class CheckResultCache:
-    def __init__(self, capacity: int = 65536, metrics=None):
+    def __init__(
+        self, capacity: int = 65536, metrics=None, name: str = "check"
+    ):
         self.capacity = capacity
         self._lock = threading.Lock()
         self._entries: OrderedDict[Hashable, bool] = OrderedDict()
         self._version: Optional[int] = None
         if metrics is not None:
             self._m_hits = metrics.counter(
-                "keto_check_cache_hits_total", "single-check cache hits"
+                f"keto_{name}_cache_hits_total", f"{name} cache hits"
             )
             self._m_misses = metrics.counter(
-                "keto_check_cache_misses_total", "single-check cache misses"
+                f"keto_{name}_cache_misses_total", f"{name} cache misses"
             )
         else:
             self._m_hits = self._m_misses = None
@@ -67,6 +74,44 @@ class CheckResultCache:
             self._entries.move_to_end(key)
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
+
+    def get_many(self, version: int, keys) -> list:
+        """Batched get: one lock acquisition for the whole batch. Returns a
+        list aligned with `keys`; None where missing."""
+        out = [None] * len(keys)
+        hits = 0
+        with self._lock:
+            if version != self._version:
+                self._entries.clear()
+                self._version = version
+            else:
+                entries = self._entries
+                get = entries.get
+                move = entries.move_to_end
+                for i, k in enumerate(keys):
+                    v = get(k)
+                    if v is not None:
+                        out[i] = v
+                        move(k)
+                        hits += 1
+        if self._m_hits is not None:
+            if hits:
+                self._m_hits.inc(hits)
+            if hits < len(keys):
+                self._m_misses.inc(len(keys) - hits)
+        return out
+
+    def put_many(self, version: int, keys, values) -> None:
+        """Batched put: one lock acquisition; same version contract as put."""
+        with self._lock:
+            if version != self._version:
+                return
+            entries = self._entries
+            for k, v in zip(keys, values):
+                entries[k] = v
+                entries.move_to_end(k)
+            while len(entries) > self.capacity:
+                entries.popitem(last=False)
 
     def __len__(self) -> int:
         with self._lock:

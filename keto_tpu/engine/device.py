@@ -56,18 +56,111 @@ def _bucket_batch(b: int) -> int:
     return _bucket(b, _MIN_BATCH)
 
 
+class EncodedBatch:
+    """A vocab-encoded batch parked between pipeline stages: staging
+    buffers filled, kernel not yet dispatched. Holds the original requests
+    so a downstream failure (circuit breaker) can re-answer exactly this
+    batch through the host oracle."""
+
+    __slots__ = (
+        "requests", "depths", "n", "b", "snap", "dg",
+        "start", "target", "depth",
+    )
+
+    def __init__(self, requests, depths, n, b, snap, dg, start, target, depth):
+        self.requests = requests
+        self.depths = depths
+        self.n = n
+        self.b = b
+        self.snap = snap
+        self.dg = dg
+        self.start = start
+        self.target = target
+        self.depth = depth
+
+    @property
+    def version(self) -> int:
+        return self.snap.version
+
+    def keys(self) -> list[tuple[int, int, int]]:
+        """Per-request (start, target, depth) id triples — the
+        snapshot-versioned encoded-request cache key."""
+        n = self.n
+        return list(
+            zip(
+                self.start[:n].tolist(),
+                self.target[:n].tolist(),
+                self.depth[:n].tolist(),
+            )
+        )
+
+    def compact(self, keep: Sequence[int]) -> None:
+        """Shrink to the `keep` rows (increasing indices) in place —
+        encoded-cache hits drop out before the kernel ever sees them.
+        Freed tail rows are reset to the inert padding state."""
+        m = len(keep)
+        if m == self.n:
+            return
+        idx = np.asarray(keep, dtype=np.int64)
+        self.start[:m] = self.start[idx]
+        self.target[:m] = self.target[idx]
+        self.depth[:m] = self.depth[idx]
+        dummy = self.dg.dummy
+        self.start[m : self.n] = dummy
+        self.target[m : self.n] = dummy
+        self.depth[m : self.n] = 0 if self.dg.mode == "packed" else 1
+        self.requests = [self.requests[i] for i in keep]
+        if self.depths is not None:
+            self.depths = [self.depths[i] for i in keep]
+        self.n = m
+
+    def release(self) -> None:
+        """Return the staging buffers to the per-bucket free-list (idempotent)."""
+        if self.start is not None:
+            self.dg.return_staging((self.start, self.target, self.depth))
+            self.start = self.target = self.depth = None
+
+
+class LaunchedBatch:
+    """A dispatched batch: the un-materialized device result. JAX async
+    dispatch means constructing this returns as soon as the kernel is
+    enqueued; blocking happens in decode (np.asarray)."""
+
+    __slots__ = ("enc", "hit", "garbage")
+
+    def __init__(self, enc: EncodedBatch, hit=None, garbage: bool = False):
+        self.enc = enc
+        self.hit = hit
+        self.garbage = garbage
+
+
 class _DeviceGraph:
     """Per-snapshot device residency: uploaded COO arrays, dense adjacency,
-    or dst-sorted edges for the bitpacked DMA kernel (``packed`` mode)."""
+    or dst-sorted edges for the bitpacked DMA kernel (``packed`` mode).
+
+    Also owns the per-bucket staging buffers for the pipelined dispatch
+    path: the (start, target, depth) int32 arrays a batch is encoded into
+    are allocated once per (bucket, snapshot) and recycled through a bounded
+    free-list instead of np.full-allocated per batch. The dummy fill value
+    is snapshot-dependent (padded_nodes - 1), which is why the buffers live
+    here and not on the engine: a snapshot swap naturally retires them."""
+
+    # free-list depth per bucket: bounds idle memory at (pipeline depth + a
+    # couple of concurrent caller-assembled batches) — beyond that a fresh
+    # allocation is cheaper than holding the arrays forever
+    _STAGING_KEEP = 8
 
     def __init__(self, snap: GraphSnapshot, mode: str):
         self.host_src = snap.src  # identity keys for the residency cache:
         self.host_dst = snap.dst  # equal arrays => equal device contents
         self.padded_nodes = snap.padded_nodes
         self.padded_edges = snap.padded_edges
+        self.dummy = snap.dummy_node
         self.mode = mode
         self.adj = self.src = self.dst = None
         self.src_by_dst = self.dst_by_dst = None
+        self._staging_lock = threading.Lock()
+        self._staging: dict[int, list] = {}
         if mode == "dense":
             self.adj = build_dense_adjacency(
                 jnp.asarray(snap.src), jnp.asarray(snap.dst), snap.padded_nodes
@@ -87,6 +180,34 @@ class _DeviceGraph:
     @property
     def dense(self) -> bool:
         return self.mode == "dense"
+
+    def checkout_staging(
+        self, b: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(start, target, depth) int32[b] buffers, reset to the inert
+        state (start/target = dummy, depth = 1) so stale rows from the
+        previous batch can never leak past the new batch's length."""
+        with self._staging_lock:
+            pool = self._staging.get(b)
+            bufs = pool.pop() if pool else None
+        if bufs is None:
+            return (
+                np.full(b, self.dummy, dtype=np.int32),
+                np.full(b, self.dummy, dtype=np.int32),
+                np.ones(b, dtype=np.int32),
+            )
+        start, target, depth = bufs
+        start.fill(self.dummy)
+        target.fill(self.dummy)
+        depth.fill(1)
+        return start, target, depth
+
+    def return_staging(self, bufs) -> None:
+        b = len(bufs[0])
+        with self._staging_lock:
+            pool = self._staging.setdefault(b, [])
+            if len(pool) < self._STAGING_KEEP:
+                pool.append(bufs)
 
 
 class DeviceCheckEngine:
@@ -167,15 +288,30 @@ class DeviceCheckEngine:
         max_depth: int = 0,
         depths: Optional[Sequence[int]] = None,
     ) -> list[bool]:
-        """Evaluate a batch; `depths` (per-request) overrides `max_depth`."""
+        """Evaluate a batch; `depths` (per-request) overrides `max_depth`.
+        Serial composition of the pipeline stages — one batch in flight."""
         if not requests:
             return []
-        # fault sites: stand-ins for an XLA compile failure and for a
-        # numerically sick chip returning garbage — the circuit breaker in
-        # engine/fallback.py is tested against exactly these
-        FAULTS.fire("device.compile_error")
-        if FAULTS.should_fire("device.batch_nan"):
-            return [float("nan")] * len(requests)
+        return self.decode_launched(
+            self.launch_encoded(self.encode_batch(requests, max_depth, depths))
+        )
+
+    # -- pipelined dispatch: encode -> launch -> decode ----------------------
+    #
+    # The three stages batch_check used to run serially, split so a
+    # pipelined caller (engine/batcher.py) can overlap them: encode batch
+    # N+1 on host threads while batch N's kernel runs (JAX async dispatch
+    # returns at enqueue), and materialize batch N-1's result (the only
+    # blocking step) off the critical path.
+
+    def encode_batch(
+        self,
+        requests: Sequence[RelationTuple],
+        max_depth: int = 0,
+        depths: Optional[Sequence[int]] = None,
+    ) -> EncodedBatch:
+        """Stage 1 (host, parallelizable): vocab-encode into persistent
+        per-(bucket, snapshot) staging buffers."""
         snap = self.snapshots.snapshot()
         dg = self._device_graph(snap)
         n = len(requests)
@@ -185,29 +321,47 @@ class DeviceCheckEngine:
             else _bucket_batch(n)
         )
         dummy = snap.dummy_node
-        start = np.full(b, dummy, dtype=np.int32)
-        target = np.full(b, dummy, dtype=np.int32)
-        depth = np.ones(b, dtype=np.int32)
-        for i, r in enumerate(requests):
-            start[i] = snap.node_for_set(r.namespace, r.object, r.relation)
-            target[i] = snap.node_for_subject(r.subject)
-            want = depths[i] if depths is not None else max_depth
-            depth[i] = clamp_depth(want, self.global_max_depth)
+        start, target, depth = dg.checkout_staging(b)
+        snap.encode_requests(requests, out_start=start, out_target=target)
+        gmax = self.global_max_depth
+        if depths is not None:
+            want = np.asarray(depths, dtype=np.int32)
+        else:
+            want = np.full(n, max_depth, dtype=np.int32)
+        depth[:n] = np.where((want <= 0) | (want > gmax), gmax, want)
+        # clamped per-request depths, captured before the packed-mode dummy
+        # override: the breaker's host-oracle re-answer needs the real ones
+        fb_depths = depth[:n].tolist()
         if dg.mode == "packed":
-            from ..ops.packed import packed_batched_check
-
             # unknown-node contract: a dummy start must not "reach" the
             # dummy target through the shared dummy row — force depth 0
             depth[:n] = np.where(
                 (start[:n] == dummy) | (target[:n] == dummy), 0, depth[:n]
             )
             depth[n:] = 0
+        return EncodedBatch(
+            list(requests), fb_depths, n, b, snap, dg, start, target, depth,
+        )
+
+    def launch_encoded(self, enc: EncodedBatch) -> LaunchedBatch:
+        """Stage 2 (the device stage): enqueue the kernel. Returns as soon
+        as dispatch is accepted — the result array is still on device."""
+        # fault sites: stand-ins for an XLA compile failure and for a
+        # numerically sick chip returning garbage — the circuit breaker in
+        # engine/fallback.py is tested against exactly these
+        FAULTS.fire("device.compile_error")
+        if FAULTS.should_fire("device.batch_nan"):
+            return LaunchedBatch(enc, garbage=True)
+        dg = enc.dg
+        if dg.mode == "packed":
+            from ..ops.packed import packed_batched_check
+
             hit = packed_batched_check(
                 dg.src_by_dst,
                 dg.dst_by_dst,
-                jnp.asarray(start),
-                jnp.asarray(target),
-                jnp.asarray(depth),
+                jnp.asarray(enc.start),
+                jnp.asarray(enc.target),
+                jnp.asarray(enc.depth),
                 padded_nodes=dg.padded_nodes,
                 max_steps=self.global_max_depth,
                 interpret=self.interpret,
@@ -215,24 +369,35 @@ class DeviceCheckEngine:
         elif dg.dense:
             hit = batched_check_dense(
                 dg.adj,
-                jnp.asarray(start),
-                jnp.asarray(target),
-                jnp.asarray(depth),
+                jnp.asarray(enc.start),
+                jnp.asarray(enc.target),
+                jnp.asarray(enc.depth),
                 max_steps=self.global_max_depth,
             )
         else:
-            chunk = pick_edge_chunk(dg.padded_edges, b)
+            chunk = pick_edge_chunk(dg.padded_edges, enc.b)
             hit = batched_check_scatter(
                 dg.src,
                 dg.dst,
-                jnp.asarray(start),
-                jnp.asarray(target),
-                jnp.asarray(depth),
+                jnp.asarray(enc.start),
+                jnp.asarray(enc.target),
+                jnp.asarray(enc.depth),
                 padded_nodes=dg.padded_nodes,
                 edge_chunk=chunk,
                 max_steps=self.global_max_depth,
             )
-        return np.asarray(hit)[:n].tolist()
+        return LaunchedBatch(enc, hit)
+
+    def decode_launched(self, launched: LaunchedBatch) -> list[bool]:
+        """Stage 3: materialize the device result (the only blocking step)
+        and recycle the staging buffers."""
+        enc = launched.enc
+        try:
+            if launched.garbage:
+                return [float("nan")] * enc.n
+            return np.asarray(launched.hit)[: enc.n].tolist()
+        finally:
+            enc.release()
 
     def distances(
         self, subject_sets: Sequence[SubjectSet], max_depth: int = 0

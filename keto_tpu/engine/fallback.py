@@ -34,6 +34,16 @@ from ..relationtuple.definitions import RelationTuple
 _COOLDOWN_CAP_S = 60.0
 
 
+class _FallbackAnswered:
+    """launch_encoded's return when the batch was answered by the host
+    oracle instead of dispatched: decode_launched just unwraps it."""
+
+    __slots__ = ("results",)
+
+    def __init__(self, results: list):
+        self.results = results
+
+
 def _valid_batch(results, n: int) -> bool:
     """The engine contract is list[bool] of the batch length. Anything else
     (short batch, NaN, floats, None) is a sick-device symptom: treat it as
@@ -208,6 +218,58 @@ class DeviceFallbackEngine:
             self._record_success()
             return [bool(v) for v in results]
         return self._fallback_check(requests, max_depth, depths)
+
+    # -- pipelined surface (encode/launch/decode split) ------------------------
+    #
+    # The batcher's pipeline reaches the engine through these instead of
+    # batch_check. Encode is host-side (vocab probes — a raise there is a
+    # caller bug, not a sick chip) and passes straight through; launch and
+    # decode are the device seams, so they carry the breaker. The contract
+    # the pipeline needs: NO in-flight batch is ever lost — a batch whose
+    # launch or decode fails is re-answered exactly (host oracle), and once
+    # the circuit trips every later launch routes to the oracle immediately,
+    # so every future already in the pipe still resolves.
+
+    def pipeline_supported(self) -> bool:
+        sup = getattr(self.primary, "pipeline_supported", None)
+        if callable(sup):
+            return sup()
+        return callable(getattr(self.primary, "encode_batch", None))
+
+    def encode_batch(self, requests, max_depth=0, depths=None):
+        return self.primary.encode_batch(requests, max_depth, depths=depths)
+
+    def launch_encoded(self, enc):
+        if self._use_primary():
+            try:
+                return self.primary.launch_encoded(enc)
+            except Exception as e:
+                self._record_failure(e)
+        # circuit open (or the launch itself died): answer this batch from
+        # the host oracle NOW — its staging buffers go back to the pool and
+        # decode becomes a no-op unwrap
+        requests, depths = enc.requests, enc.depths
+        enc.release()
+        return _FallbackAnswered(
+            self._fallback_check(requests, 0, depths)
+        )
+
+    def decode_launched(self, launched) -> list[bool]:
+        if isinstance(launched, _FallbackAnswered):
+            return launched.results
+        enc = launched.enc
+        n = enc.n
+        requests, depths = enc.requests, enc.depths
+        try:
+            results = self.primary.decode_launched(launched)
+        except Exception as e:
+            self._record_failure(e)
+            return self._fallback_check(requests, 0, depths)
+        if not _valid_batch(results, n):
+            self._record_failure(None)
+            return self._fallback_check(requests, 0, depths)
+        self._record_success()
+        return [bool(v) for v in results]
 
     def _fallback_check(self, requests, max_depth, depths) -> list[bool]:
         if self._m_fallback_batches is not None:

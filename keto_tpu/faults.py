@@ -33,6 +33,12 @@ site                      effect when armed
 ``batcher.dispatcher_die``  the CheckBatcher dispatcher thread raises and
                           dies at the top of its loop; the watchdog must
                           restart it (engine/batcher.py)
+``batcher.encode_die``    a pipeline encode worker raises and dies at the
+                          top of its loop; its held batch must fail typed
+                          and the stage restart (engine/batcher.py)
+``batcher.decode_die``    the pipeline decode thread raises and dies at the
+                          top of its loop; its held batch must fail typed
+                          and the stage restart (engine/batcher.py)
 ``device.compile_error``  ``DeviceCheckEngine.batch_check`` raises as an XLA
                           compile failure would (engine/device.py)
 ``device.batch_nan``      the device engine returns non-boolean garbage for

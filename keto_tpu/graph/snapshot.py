@@ -31,7 +31,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from ..relationtuple.definitions import RelationTuple, Subject
+from ..relationtuple.definitions import RelationTuple, Subject, SubjectID
 from .vocab import NodeVocab, set_key, subject_node_key
 
 _MIN_NODES = 1024
@@ -88,6 +88,53 @@ class GraphSnapshot:
         if nid is None or nid >= self.padded_nodes:
             return self.dummy_node
         return nid
+
+    def encode_requests(
+        self,
+        requests: Sequence[RelationTuple],
+        out_start: Optional[np.ndarray] = None,
+        out_target: Optional[np.ndarray] = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Bulk vocab-encode: requests -> (start, target) node ids, unknown
+        or beyond-this-snapshot ids clamped to the inert dummy node. The
+        batched twin of node_for_set/node_for_subject: one hash pass
+        (native.request_hashes when available) plus one vectorized index
+        probe instead of 2n Python dict probes — the encode stage of the
+        check pipeline. When `out_start`/`out_target` are given, rows
+        [0, n) are written in place (persistent staging buffers) and the
+        same arrays are returned."""
+        n = len(requests)
+        vocab = self.vocab
+        from .. import native
+
+        if native.lib is not None and native.tuple_hash_ok:
+            hs, ht, _ = native.request_hashes(requests, SubjectID)
+
+            def skey(i: int):
+                r = requests[i]
+                return (r.namespace, r.object, r.relation)
+
+            def tkey(i: int):
+                return subject_node_key(requests[i].subject)
+
+            s_ids = vocab.lookup_hashes(hs, skey)
+            t_ids = vocab.lookup_hashes(ht, tkey)
+        else:
+            s_ids = vocab.lookup_bulk(
+                [(r.namespace, r.object, r.relation) for r in requests]
+            )
+            t_ids = vocab.lookup_bulk(
+                [subject_node_key(r.subject) for r in requests]
+            )
+        pn = self.padded_nodes
+        dummy = self.dummy_node
+        s = np.where((s_ids < 0) | (s_ids >= pn), dummy, s_ids)
+        t = np.where((t_ids < 0) | (t_ids >= pn), dummy, t_ids)
+        if out_start is None or out_target is None:
+            return s.astype(np.int32), t.astype(np.int32)
+        out_start[:n] = s
+        out_target[:n] = t
+        return out_start, out_target
 
     def csr(self) -> tuple[np.ndarray, np.ndarray]:
         """(indptr int32[padded_nodes+1], indices int32[padded_edges]) sorted

@@ -219,3 +219,32 @@ class MetricsRegistry:
             for labels, child in m._series():
                 out.extend(child._expose(labels))
         return "\n".join(out) + "\n"
+
+
+# -- check-pipeline stage telemetry -----------------------------------------
+
+# the stages of the pipelined check dispatch (engine/batcher.py), in flow
+# order: enqueue = wait in the admission queue, encode = vocab-encode +
+# encoded-cache probe, launch = launch-queue wait + kernel enqueue (async
+# dispatch), device = block-until-materialized, decode = future resolution
+# + cache population
+PIPELINE_STAGES = ("enqueue", "encode", "launch", "device", "decode")
+
+# stage latencies sit well under the end-to-end DEFAULT_BUCKETS: a healthy
+# pipeline spends tens of microseconds to single-digit milliseconds per
+# stage, so the buckets start 10x lower
+PIPELINE_STAGE_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 1.0,
+)
+
+
+def pipeline_stage_histogram(registry: MetricsRegistry) -> Histogram:
+    """The per-stage latency histogram every pipelined batcher reports
+    into — one series per PIPELINE_STAGES label value."""
+    return registry.histogram(
+        "keto_pipeline_stage_seconds",
+        "per-batch latency of each check-pipeline stage",
+        labelnames=("stage",),
+        buckets=PIPELINE_STAGE_BUCKETS,
+    )

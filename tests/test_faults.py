@@ -150,6 +150,144 @@ class TestDispatcherWatchdog:
             b.close()
 
 
+class _FakeEncoded:
+    """Stand-in for engine.device.EncodedBatch: just enough surface for
+    the pipelined batcher (version/keys for the encoded cache, release for
+    crash cleanup)."""
+
+    version = 0
+
+    def __init__(self, requests):
+        self.requests = list(requests)
+        self.released = False
+
+    def keys(self):
+        return [(r.object, 0, 0) for r in self.requests]
+
+    def compact(self, keep):
+        self.requests = [self.requests[i] for i in keep]
+
+    def release(self):
+        self.released = True
+
+
+class _SplitEngine:
+    """Minimal split encode/launch/decode engine: deterministic True
+    answers, so the pipeline drills isolate STAGE failure handling from
+    engine behavior."""
+
+    def pipeline_supported(self):
+        return True
+
+    def encode_batch(self, requests, max_depth=0, depths=None):
+        return _FakeEncoded(requests)
+
+    def launch_encoded(self, enc):
+        return enc
+
+    def decode_launched(self, launched):
+        return [True] * len(launched.requests)
+
+    def batch_check(self, requests, max_depth=0, depths=None):
+        return [True] * len(requests)
+
+
+class TestPipelineStageWatchdog:
+    """ISSUE-2 drills: a pipeline stage death (encode worker, decode
+    thread) fails exactly the in-flight batch typed-retryable and the
+    watchdog restarts the stage — queued work and the other stages keep
+    serving."""
+
+    def _pipelined(self, metrics=None):
+        return CheckBatcher(
+            _SplitEngine(),
+            window_s=0,
+            metrics=metrics,
+            pipeline_depth=2,
+            encode_workers=2,
+        )
+
+    @pytest.mark.parametrize(
+        "site", ["batcher.encode_die", "batcher.decode_die"]
+    )
+    def test_stage_death_fails_inflight_typed_and_restarts(self, site):
+        m = MetricsRegistry()
+        b = self._pipelined(metrics=m)
+        try:
+            assert b.pipelined is True
+            restarts = b._m_restarts
+            FAULTS.arm(site)
+            # the armed fault kills the stage while it HOLDS this batch:
+            # the caller must get the typed retryable error, not a hang
+            with pytest.raises(DispatcherCrashed) as ei:
+                b.check(_tup(), timeout=10)
+            assert ei.value.grpc_code == "INTERNAL"
+            assert FAULTS.fired(site) == 1
+            deadline = time.time() + 5
+            while restarts.value < 1 and time.time() < deadline:
+                time.sleep(0.01)
+            assert restarts.value == 1
+            # the restarted stage serves the next request
+            assert b.check(_tup(1), timeout=10) is True
+            # nothing leaked: the failed batch left the pipeline registry
+            assert b.pipeline_stats()["batches_in_pipeline"] == 0
+        finally:
+            b.close()
+
+    def test_stage_crash_releases_encoded_buffers(self):
+        class _TrackingSplit(_SplitEngine):
+            def encode_batch(self, requests, max_depth=0, depths=None):
+                self.last_enc = _FakeEncoded(requests)
+                return self.last_enc
+
+        eng = _TrackingSplit()
+        b = CheckBatcher(eng, window_s=0, pipeline_depth=2, encode_workers=1)
+        try:
+            FAULTS.arm("batcher.decode_die")
+            with pytest.raises(DispatcherCrashed):
+                b.check(_tup(), timeout=10)
+            # the crash path returned the staging buffers to the pool
+            # (enc.release) instead of leaking them until GC
+            assert eng.last_enc.released is True
+            assert b.check(_tup(1), timeout=10) is True
+        finally:
+            b.close()
+
+    def test_pipelined_close_fails_stragglers_typed(self):
+        class _StuckSplit(_SplitEngine):
+            def __init__(self):
+                self.gate = threading.Event()
+
+            def decode_launched(self, launched):
+                self.gate.wait(timeout=10)  # wedged device materialization
+                return [True] * len(launched.requests)
+
+        eng = _StuckSplit()
+        b = CheckBatcher(
+            eng, window_s=0, pipeline_depth=2, encode_workers=1
+        )
+        b.close_join_s = 0.2
+        errs = []
+
+        def call():
+            try:
+                b.check(_tup(), timeout=10)
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=call, daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while not b.pipeline_stats()["batches_in_pipeline"] and (
+            time.time() < deadline
+        ):
+            time.sleep(0.005)
+        b.close()  # join budget expires; the held batch fails typed
+        t.join(timeout=5)
+        assert len(errs) == 1 and isinstance(errs[0], BatcherClosed)
+        eng.gate.set()
+
+
 class TestLoadShedding:
     def test_queue_full_sheds_with_429_semantics(self):
         eng = _GateEngine()
@@ -350,6 +488,7 @@ class TestDeviceCircuitBreaker:
                 "engine": {
                     "mode": "device",
                     "cache_size": 0,  # a cache hit would mask the faults
+                    "encoded_cache_size": 0,  # ditto for the encoded cache
                     "fallback_threshold": 2,
                     "fallback_cooldown_ms": 50,
                 },

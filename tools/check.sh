@@ -3,7 +3,10 @@
 #
 #   1. import hygiene — every keto_tpu module imports (catches moved
 #      upstream APIs like the jax shard_map relocation at CI time)
-#   2. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
+#   2. bench smoke — bench.py --smoke end-to-end (tiny config, short
+#      server leg): the serving path must boot, answer, and emit its
+#      summary JSON with exit 0
+#   3. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
 #
 # Usage: bash tools/check.sh            (from the repo root)
 set -o pipefail
@@ -11,6 +14,9 @@ cd "$(dirname "$0")/.."
 
 echo "== import hygiene =="
 JAX_PLATFORMS=cpu python tools/verify_imports.py || exit 1
+
+echo "== bench smoke =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python bench.py --smoke || exit 1
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
