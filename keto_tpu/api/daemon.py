@@ -163,10 +163,24 @@ def _interceptors(plane, logger, metrics, tracer):
     )
 
 
+def grpc_message_options(max_message_bytes: int) -> list:
+    """Channel/server options lifting grpc's 4 MiB message cap — columnar
+    BatchCheck payloads (hundreds of thousands of rows per RPC) blow past
+    it. 0 keeps the grpc defaults. Shared by the servers here and the
+    cmd-side clients so both ends agree."""
+    if not max_message_bytes:
+        return []
+    return [
+        ("grpc.max_receive_message_length", int(max_message_bytes)),
+        ("grpc.max_send_message_length", int(max_message_bytes)),
+    ]
+
+
 def build_read_grpc_server(
     checker, expand_engine, manager, snaptoken_fn, version: str,
     health: HealthServicer, max_workers: int = 32,
     logger=None, metrics=None, tracer=None,
+    max_message_bytes: int = 0,
 ) -> grpc.Server:
     """Read-plane gRPC: Check + Expand + Read + Version + Health +
     reflection, behind the telemetry interceptor chain (reference
@@ -177,6 +191,7 @@ def build_read_grpc_server(
     server = grpc.server(
         executor,
         interceptors=_interceptors("read", logger, metrics, tracer),
+        options=grpc_message_options(max_message_bytes),
     )
     server._keto_executor = executor  # joined by PlaneServer.stop
     add_check_service(server, CheckServicer(checker, snaptoken_fn))
@@ -191,6 +206,7 @@ def build_write_grpc_server(
     manager, snaptoken_fn, version: str,
     health: HealthServicer, max_workers: int = 32,
     logger=None, metrics=None, tracer=None,
+    max_message_bytes: int = 0,
 ) -> grpc.Server:
     """Write-plane gRPC: Write + Version + Health + reflection (reference
     WriteGRPCServer, registry_default.go:387-401)."""
@@ -200,6 +216,7 @@ def build_write_grpc_server(
     server = grpc.server(
         executor,
         interceptors=_interceptors("write", logger, metrics, tracer),
+        options=grpc_message_options(max_message_bytes),
     )
     server._keto_executor = executor  # joined by PlaneServer.stop
     add_write_service(server, WriteServicer(manager, snaptoken_fn))

@@ -34,6 +34,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..relationtuple.columns import CheckColumns
 from ..relationtuple.definitions import (
     RelationQuery,
     RelationTuple,
@@ -311,14 +312,36 @@ class ReadAPI:
 
     async def post_check_batch(self, request: web.Request) -> web.Response:
         """keto_tpu extension: many checks per request. Body is either a
-        bare json array of relation tuples or {"tuples": [...],
-        "max_depth": n}. Response: {"allowed": [...], "snaptoken": "..."}
-        with answers in request order, always 200 (per-item allow/deny is
-        in the body, unlike the single check's 200/403)."""
+        bare json array of relation tuples, {"tuples": [...],
+        "max_depth": n}, or the columnar form {"namespaces": [...],
+        "objects": [...], "relations": [...], "subject_ids": [...],
+        "subject_set_namespaces": [...], ...} of parallel string arrays
+        (zero per-tuple objects on the hot path). Response: {"allowed":
+        [...], "snaptoken": "..."} with answers in request order, always
+        200 (per-item allow/deny is in the body, unlike the single
+        check's 200/403)."""
         body = await _json_body(request)
         p = request.rel_url.query
         max_depth = max_depth_from_query(p)
         min_version = _min_version_from_query(p)
+        if isinstance(body, dict) and "namespaces" in body:
+            cols = CheckColumns.from_rest_body(body)
+            max_depth = int(body.get("max_depth", max_depth) or max_depth)
+            run = getattr(self.checker, "check_batch_columnar", None)
+            if run is None:
+                def work(md=max_depth, mv=min_version):
+                    return self.checker.check_batch(
+                        cols.materialize(), md, min_version=mv
+                    )
+            else:
+                def work(md=max_depth, mv=min_version):
+                    return run(cols, md, min_version=mv)
+            allowed = await asyncio.get_running_loop().run_in_executor(
+                self.executor, work
+            )
+            return web.json_response(
+                {"allowed": allowed, "snaptoken": self.snaptoken_fn()}
+            )
         if isinstance(body, dict):
             items = body.get("tuples")
             max_depth = int(body.get("max_depth", max_depth) or max_depth)
@@ -389,6 +412,8 @@ class WriteAPI:
 
     async def create_relation(self, request: web.Request) -> web.Response:
         body = await _json_body(request)
+        if not isinstance(body, dict):
+            raise ErrMalformedInput("expected a json relation-tuple object")
         tup = RelationTuple.from_dict(body)
         self.manager.write_relation_tuples(tup)
         location = ROUTE_TUPLES + "?" + _tuple_location_query(tup)

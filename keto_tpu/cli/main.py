@@ -40,7 +40,11 @@ def _write_remote(ctx) -> str:
 
 
 def _channel(remote: str) -> grpc.Channel:
-    ch = grpc.insecure_channel(remote)
+    from ..api.daemon import grpc_message_options
+
+    # match the server's lifted message cap (serve.*.grpc-max-message-size
+    # default) so large batch payloads round-trip
+    ch = grpc.insecure_channel(remote, options=grpc_message_options(64 << 20))
     try:
         grpc.channel_ready_future(ch).result(timeout=_CONN_TIMEOUT_S)
     except grpc.FutureTimeoutError:

@@ -79,6 +79,10 @@ _PORT_SCHEMA = {
         # read plane only: number of forked read-replica worker processes
         # sharing the port via SO_REUSEPORT (driver/replicas.py)
         "workers": {"type": "integer", "minimum": 1},
+        # gRPC max receive/send message bytes on this plane's server (and
+        # the cmd-side clients); large columnar BatchCheck payloads exceed
+        # grpc's 4 MiB default. 0 = leave the grpc default
+        "grpc-max-message-size": {"type": "integer", "minimum": 0},
     },
     "additionalProperties": True,
 }
@@ -213,8 +217,10 @@ DEFAULTS = {
     "serve.read.host": "",
     "serve.read.max-depth": 5,
     "serve.read.workers": 1,
+    "serve.read.grpc-max-message-size": 64 << 20,
     "serve.write.port": 4467,
     "serve.write.host": "",
+    "serve.write.grpc-max-message-size": 64 << 20,
     "log.level": "info",
     "log.format": "text",
     "tracing.provider": "",

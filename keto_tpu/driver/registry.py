@@ -397,6 +397,9 @@ class Registry:
                 logger=self.logger(),
                 metrics=self.metrics(),
                 tracer=self.tracer(),
+                max_message_bytes=int(
+                    self.config.get("serve.read.grpc-max-message-size")
+                ),
             )
             app = build_read_app(
                 self.store(),
@@ -448,6 +451,9 @@ class Registry:
                 logger=self.logger(),
                 metrics=self.metrics(),
                 tracer=self.tracer(),
+                max_message_bytes=int(
+                    self.config.get("serve.write.grpc-max-message-size")
+                ),
             )
             app = build_write_app(
                 self.store(),

@@ -146,7 +146,10 @@ class CheckBatcher:
         )
         self.pipelined = pipeline_depth >= 1 and capable
         self.encoded_cache = None
-        if self.pipelined and encoded_cache_size > 0:
+        # the encoded-request cache serves BOTH the pipelined single-check
+        # path and the columnar batch transport, so it only needs a capable
+        # engine — not the pipeline threads
+        if capable and encoded_cache_size > 0:
             from .cache import CheckResultCache
 
             self.encoded_cache = CheckResultCache(
@@ -156,6 +159,7 @@ class CheckBatcher:
         self._m_shed = None
         self._m_restarts = None
         self._m_stage = None
+        self._m_columnar = None
         if metrics is not None:
             self._m_batch_size = metrics.histogram(
                 "keto_batcher_batch_size",
@@ -169,6 +173,11 @@ class CheckBatcher:
             self._m_restarts = metrics.counter(
                 "keto_batcher_dispatcher_restarts_total",
                 "dispatch stage thread deaths recovered by the watchdog",
+            )
+            self._m_columnar = metrics.counter(
+                "keto_batcher_columnar_batches_total",
+                "caller-assembled batches served through the columnar "
+                "zero-object path",
             )
             metrics.gauge(
                 "keto_batcher_queue_depth",
@@ -345,6 +354,224 @@ class CheckBatcher:
         for i, v in zip(miss_idx, res):
             out[i] = bool(v)
         return out
+
+    def check_batch_columnar(
+        self,
+        cols,
+        max_depth: int = 0,
+        min_version: int = 0,
+        timeout: Optional[float] = None,
+    ) -> list[bool]:
+        """Columnar twin of ``check_batch``: the caller-assembled batch
+        arrives as a ``CheckColumns`` and stays columnar through vocab
+        encode and the kernel. Engines with the columnar split API probe
+        the encoded-request cache in bulk on the encoded
+        (snapshot_version, start, target, depth) id triples; no
+        ``RelationTuple``/``Subject`` objects are built unless the
+        circuit-breaker fallback fires (lazy materialization inside
+        ``EncodedBatch``)."""
+        if self._closed:
+            raise BatcherClosed()
+        n = len(cols)
+        if n == 0:
+            return []
+        if min_version > 0:
+            wait = getattr(self.engine, "wait_for_version", None)
+            if wait is not None:
+                wait(
+                    min_version,
+                    timeout_s=timeout if timeout is not None else 30.0,
+                )
+        if self._m_columnar is not None:
+            self._m_columnar.inc()
+        if getattr(self.engine, "encode_columns", None) is None:
+            return self._columns_via_engine(cols, max_depth)
+        out: list[bool] = []
+        for i in range(0, n, self.max_batch):
+            chunk = (
+                cols
+                if n <= self.max_batch
+                else cols.select(range(i, min(i + self.max_batch, n)))
+            )
+            out.extend(self._dispatch_columns(chunk, max_depth))
+        return out
+
+    def _dispatch_columns(self, cols, max_depth: int) -> list[bool]:
+        """One encoded columnar dispatch: encode into staging, resolve
+        cache hits, launch only the misses."""
+        enc = self.engine.encode_columns(cols, max_depth)
+        cache = self.encoded_cache
+        if cache is None:
+            return [
+                bool(v)
+                for v in self.engine.decode_launched(
+                    self.engine.launch_encoded(enc)
+                )
+            ]
+        keys = enc.keys()
+        cached = cache.get_many(enc.version, keys)
+        miss = [i for i, v in enumerate(cached) if v is None]
+        if not miss:
+            enc.release()
+            return [bool(v) for v in cached]
+        if len(miss) < len(keys):
+            enc.compact(miss)
+        res = self.engine.decode_launched(self.engine.launch_encoded(enc))
+        cache.put_many(
+            enc.version, [keys[i] for i in miss], [bool(v) for v in res]
+        )
+        out = [None if v is None else bool(v) for v in cached]
+        for i, v in zip(miss, res):
+            out[i] = bool(v)
+        return out
+
+    def _columns_via_engine(self, cols, max_depth: int) -> list[bool]:
+        """Engines without the columnar split API (closure, host oracle):
+        dispatch via their ``batch_check_columns`` when present (closure's
+        array path), else materialized tuples — with the result cache
+        probed in bulk on flat string row keys, not request objects."""
+        if self.cache is None:
+            return self._run_columns(cols, max_depth)
+        version = self.version_fn()
+        keys = cols.row_keys(max_depth)
+        cached = self.cache.get_many(version, keys)
+        miss = [i for i, v in enumerate(cached) if v is None]
+        if not miss:
+            return [bool(v) for v in cached]
+        sub = cols.select(miss) if len(miss) < len(cols) else cols
+        res = self._run_columns(sub, max_depth)
+        self.cache.put_many(version, [keys[i] for i in miss], res)
+        out = [None if v is None else bool(v) for v in cached]
+        for i, v in zip(miss, res):
+            out[i] = bool(v)
+        return out
+
+    def _run_columns(self, cols, max_depth: int) -> list[bool]:
+        run = getattr(self.engine, "batch_check_columns", None)
+        out: list[bool] = []
+        n = len(cols)
+        for i in range(0, n, self.max_batch):
+            chunk = (
+                cols
+                if n <= self.max_batch
+                else cols.select(range(i, min(i + self.max_batch, n)))
+            )
+            if run is not None:
+                out.extend(bool(v) for v in run(chunk, max_depth))
+            else:
+                out.extend(
+                    bool(v)
+                    for v in self.engine.batch_check(
+                        chunk.materialize(), max_depth
+                    )
+                )
+        return out
+
+    def check_batch_encoded(
+        self,
+        start_ids,
+        target_ids,
+        depths=None,
+        min_version: int = 0,
+        timeout: Optional[float] = None,
+    ) -> list[bool]:
+        """Pre-encoded id batches (array-native clients, bench): probe the
+        encoded cache on (start, target, depth) triples and dispatch only
+        the misses through the engine's array path — zero per-item Python
+        objects end to end."""
+        if self._closed:
+            raise BatcherClosed()
+        n = len(start_ids)
+        if n == 0:
+            return []
+        if min_version > 0:
+            wait = getattr(self.engine, "wait_for_version", None)
+            if wait is not None:
+                wait(
+                    min_version,
+                    timeout_s=timeout if timeout is not None else 30.0,
+                )
+        import numpy as np
+
+        s = np.asarray(start_ids, dtype=np.int64)
+        t = np.asarray(target_ids, dtype=np.int64)
+        gmax = int(getattr(self.engine, "global_max_depth", 0) or 0)
+        if depths is not None:
+            want = np.asarray(depths, dtype=np.int32)
+        else:
+            want = np.zeros(n, dtype=np.int32)
+        if gmax > 0:
+            d = np.where((want <= 0) | (want > gmax), gmax, want)
+        else:
+            d = want
+        out: list[bool] = []
+        for i in range(0, n, self.max_batch):
+            out.extend(
+                self._dispatch_encoded(
+                    s[i : i + self.max_batch],
+                    t[i : i + self.max_batch],
+                    d[i : i + self.max_batch],
+                )
+            )
+        return out
+
+    def _dispatch_encoded(self, s, t, d) -> list[bool]:
+        cache = self.encoded_cache
+        keys = None
+        if cache is not None and self.version_fn is not None:
+            version = self.version_fn()
+            keys = list(zip(s.tolist(), t.tolist(), d.tolist()))
+            cached = cache.get_many(version, keys)
+            miss = [i for i, v in enumerate(cached) if v is None]
+            if not miss:
+                return [bool(v) for v in cached]
+            if len(miss) < len(keys):
+                s, t, d = s[miss], t[miss], d[miss]
+        res = self._run_encoded(s, t, d)
+        if keys is not None:
+            cache.put_many(
+                version,
+                [keys[i] for i in miss],
+                [bool(v) for v in res],
+            )
+            out = [None if v is None else bool(v) for v in cached]
+            for i, v in zip(miss, res):
+                out[i] = bool(v)
+            return out
+        return [bool(v) for v in res]
+
+    def _run_encoded(self, s, t, d) -> list[bool]:
+        # prefer the split encode/launch/decode path: the circuit-breaker
+        # wrapper overrides launch/decode, so a breaker-open or failed
+        # batch is re-answered by the host oracle from tuples the
+        # EncodedBatch materializes lazily out of the id arrays
+        encode_ids = getattr(self.engine, "encode_ids", None)
+        if encode_ids is not None:
+            enc = encode_ids(s, t, d)
+            return [
+                bool(v)
+                for v in self.engine.decode_launched(
+                    self.engine.launch_encoded(enc)
+                )
+            ]
+        check_ids = getattr(self.engine, "check_ids", None)
+        if check_ids is None:
+            raise ErrInternal(
+                "engine has no array-native check path "
+                "(check_batch_encoded needs check_ids or encode_ids)"
+            )
+        import numpy as np
+
+        # the closure engine's array path wants per-row subject kinds;
+        # derive them from the vocab (ids out of range read as sets —
+        # they clamp to the inert dummy downstream anyway)
+        is_id = np.zeros(len(t), dtype=bool)
+        snaps = getattr(self.engine, "snapshots", None)
+        if snaps is not None:
+            is_set = snaps.snapshot().vocab.is_set_array()
+            safe = (t >= 0) & (t < len(is_set))
+            is_id[safe] = ~is_set[t[safe]]
+        return [bool(v) for v in check_ids(s, t, is_id, d)]
 
     def close(self) -> None:
         with self._cv:

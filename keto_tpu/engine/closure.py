@@ -759,6 +759,52 @@ class ClosureCheckEngine:
             self._m_batch_s.observe(time.perf_counter() - t0)
         return allowed.tolist()
 
+    def batch_check_columns(
+        self,
+        cols,
+        max_depth: int = 0,
+        depths: Optional[Sequence[int]] = None,
+    ) -> list[bool]:
+        """Columnar batch check: the ``CheckColumns`` string lists are
+        vocab-encoded directly (zipped key tuples -> lookup_bulk) with no
+        ``RelationTuple``/``Subject`` objects on the answer path. Tuples
+        materialize lazily only on the oversized-interior fallback and on
+        overflow rows (``_check_arrays`` decodes those from the vocab)."""
+        n = len(cols)
+        if not n:
+            return []
+        t0 = time.perf_counter()
+        state, pinned = self._serving_pinned()
+        if not isinstance(state, _ClosureArtifacts):
+            # interior too large for a closure: exact fallback (the only
+            # path that needs real tuple objects)
+            return self.fallback_engine().batch_check(
+                cols.materialize(), max_depth, depths
+            )
+        art = state
+        snap = art.snap
+        tkeys = cols.target_keys()
+        s_ids = snap.vocab.lookup_bulk(cols.start_keys())
+        t_ids = snap.vocab.lookup_bulk(tkeys)
+        is_id = np.fromiter(
+            (len(k) == 1 for k in tkeys), dtype=bool, count=n
+        )
+        gmax = self.global_max_depth
+        if depths is not None:
+            want = np.asarray(depths, dtype=np.int32)
+        else:
+            want = np.full(n, max_depth, dtype=np.int32)
+        depth = np.where((want <= 0) | (want > gmax), gmax, want).astype(
+            np.int32
+        )
+        allowed = self._check_arrays(
+            snap, art, s_ids, t_ids, is_id, depth, pinned
+        )
+        if self._m_checks is not None:
+            self._m_checks.inc(n)
+            self._m_batch_s.observe(time.perf_counter() - t0)
+        return allowed.tolist()
+
     def check_ids(
         self,
         start: np.ndarray,

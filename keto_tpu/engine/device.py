@@ -56,19 +56,47 @@ def _bucket_batch(b: int) -> int:
     return _bucket(b, _MIN_BATCH)
 
 
+def _decode_ids(snap, start, target) -> list:
+    """Vocab-decode id pairs back to RelationTuples (fallback paths of
+    pre-encoded batches only; the hot path never runs this)."""
+    vocab = snap.vocab
+    n_live = len(vocab)
+    out = []
+    for s, t in zip(start, target):
+        if int(s) < n_live:
+            ns, obj, rel = vocab.key(int(s))
+        else:  # dummy/unknown start: resolves to no tuples downstream
+            ns = obj = rel = ""
+        subject = (
+            vocab.subject_of(int(t)) if int(t) < n_live else SubjectID(id="")
+        )
+        out.append(
+            RelationTuple(
+                namespace=ns, object=obj, relation=rel, subject=subject
+            )
+        )
+    return out
+
+
 class EncodedBatch:
     """A vocab-encoded batch parked between pipeline stages: staging
     buffers filled, kernel not yet dispatched. Holds the original requests
-    so a downstream failure (circuit breaker) can re-answer exactly this
-    batch through the host oracle."""
+    (or, on the columnar path, the raw columns) so a downstream failure
+    (circuit breaker) can re-answer exactly this batch through the host
+    oracle — columnar batches materialize their ``RelationTuple`` objects
+    lazily, ONLY if that fallback actually fires."""
 
     __slots__ = (
-        "requests", "depths", "n", "b", "snap", "dg",
+        "_requests", "_cols", "depths", "n", "b", "snap", "dg",
         "start", "target", "depth",
     )
 
-    def __init__(self, requests, depths, n, b, snap, dg, start, target, depth):
-        self.requests = requests
+    def __init__(
+        self, requests, depths, n, b, snap, dg, start, target, depth,
+        cols=None,
+    ):
+        self._requests = requests
+        self._cols = cols
         self.depths = depths
         self.n = n
         self.b = b
@@ -77,6 +105,20 @@ class EncodedBatch:
         self.start = start
         self.target = target
         self.depth = depth
+
+    @property
+    def requests(self):
+        """Per-item RelationTuples. Columnar batches build them here on
+        first access — the hot path (launch/decode) never reads this.
+        Pure-id batches (check_ids) decode through the snapshot vocab."""
+        if self._requests is None:
+            if self._cols is not None:
+                self._requests = self._cols.materialize()
+            else:
+                self._requests = _decode_ids(
+                    self.snap, self.start[: self.n], self.target[: self.n]
+                )
+        return self._requests
 
     @property
     def version(self) -> int:
@@ -109,7 +151,10 @@ class EncodedBatch:
         self.start[m : self.n] = dummy
         self.target[m : self.n] = dummy
         self.depth[m : self.n] = 0 if self.dg.mode == "packed" else 1
-        self.requests = [self.requests[i] for i in keep]
+        if self._requests is not None:
+            self._requests = [self._requests[i] for i in keep]
+        if self._cols is not None:
+            self._cols = self._cols.select(keep)
         if self.depths is not None:
             self.depths = [self.depths[i] for i in keep]
         self.n = m
@@ -341,6 +386,115 @@ class DeviceCheckEngine:
             depth[n:] = 0
         return EncodedBatch(
             list(requests), fb_depths, n, b, snap, dg, start, target, depth,
+        )
+
+    def encode_columns(
+        self,
+        cols,
+        max_depth: int = 0,
+        depths: Optional[Sequence[int]] = None,
+    ) -> EncodedBatch:
+        """Columnar stage 1: a ``CheckColumns`` batch vocab-encodes straight
+        from its parallel string lists into the staging buffers — no
+        ``RelationTuple``/``Subject`` objects on the hot path (they
+        materialize lazily only if the breaker fallback needs them)."""
+        snap = self.snapshots.snapshot()
+        dg = self._device_graph(snap)
+        n = len(cols)
+        b = (
+            _PACKED_MIN_BATCH * ((n + _PACKED_MIN_BATCH - 1) // _PACKED_MIN_BATCH)
+            if dg.mode == "packed"
+            else _bucket_batch(n)
+        )
+        dummy = snap.dummy_node
+        start, target, depth = dg.checkout_staging(b)
+        snap.encode_requests_columnar(cols, out_start=start, out_target=target)
+        gmax = self.global_max_depth
+        if depths is not None:
+            want = np.asarray(depths, dtype=np.int32)
+        else:
+            want = np.full(n, max_depth, dtype=np.int32)
+        depth[:n] = np.where((want <= 0) | (want > gmax), gmax, want)
+        fb_depths = depth[:n].tolist()
+        if dg.mode == "packed":
+            depth[:n] = np.where(
+                (start[:n] == dummy) | (target[:n] == dummy), 0, depth[:n]
+            )
+            depth[n:] = 0
+        return EncodedBatch(
+            None, fb_depths, n, b, snap, dg, start, target, depth, cols=cols,
+        )
+
+    def batch_check_columns(
+        self,
+        cols,
+        max_depth: int = 0,
+        depths: Optional[Sequence[int]] = None,
+    ) -> list[bool]:
+        """Serial columnar dispatch — the zero-object twin of batch_check."""
+        if not len(cols):
+            return []
+        return self.decode_launched(
+            self.launch_encoded(self.encode_columns(cols, max_depth, depths))
+        )
+
+    def check_ids(
+        self,
+        start,
+        target,
+        is_id=None,
+        depths: Optional[Sequence[int]] = None,
+    ) -> np.ndarray:
+        """Array-native check over pre-encoded vocab ids: bool[n] out,
+        zero per-request Python (the frontier kernels don't distinguish
+        subject-id from subject-set targets, so ``is_id`` is accepted for
+        signature parity with the closure engine and ignored). Unknown or
+        beyond-snapshot ids are clamped to the inert dummy node."""
+        n = len(start)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        enc = self.encode_ids(start, target, depths)
+        return np.asarray(
+            self.decode_launched(self.launch_encoded(enc)), dtype=bool
+        )
+
+    def encode_ids(
+        self,
+        start,
+        target,
+        depths: Optional[Sequence[int]] = None,
+    ) -> EncodedBatch:
+        """Stage 1 for pre-encoded id batches (check_batch_encoded): the
+        ids go straight into staging — no vocab probe at all."""
+        snap = self.snapshots.snapshot()
+        dg = self._device_graph(snap)
+        n = len(start)
+        b = (
+            _PACKED_MIN_BATCH * ((n + _PACKED_MIN_BATCH - 1) // _PACKED_MIN_BATCH)
+            if dg.mode == "packed"
+            else _bucket_batch(n)
+        )
+        dummy = snap.dummy_node
+        pn = snap.padded_nodes
+        st, tg, dp = dg.checkout_staging(b)
+        s = np.asarray(start, dtype=np.int64)
+        t = np.asarray(target, dtype=np.int64)
+        st[:n] = np.where((s < 0) | (s >= pn), dummy, s)
+        tg[:n] = np.where((t < 0) | (t >= pn), dummy, t)
+        gmax = self.global_max_depth
+        if depths is not None:
+            want = np.asarray(depths, dtype=np.int32)
+        else:
+            want = np.full(n, 0, dtype=np.int32)
+        dp[:n] = np.where((want <= 0) | (want > gmax), gmax, want)
+        fb_depths = dp[:n].tolist()
+        if dg.mode == "packed":
+            dp[:n] = np.where(
+                (st[:n] == dummy) | (tg[:n] == dummy), 0, dp[:n]
+            )
+            dp[n:] = 0
+        return EncodedBatch(
+            None, fb_depths, n, b, snap, dg, st, tg, dp,
         )
 
     def launch_encoded(self, enc: EncodedBatch) -> LaunchedBatch:

@@ -259,17 +259,69 @@ class DeviceFallbackEngine:
             return launched.results
         enc = launched.enc
         n = enc.n
-        requests, depths = enc.requests, enc.depths
+        depths = enc.depths
+        # Lazy materialization: per-tuple batches hold their requests and
+        # columnar batches hold their columns, so the oracle's tuples are
+        # built ONLY inside the failure branches below — a healthy decode
+        # never touches per-item objects. Pure-id batches (encode_ids) are
+        # the one exception: they can only decode back to tuples while
+        # their staging buffers are alive, and primary.decode_launched
+        # releases those, so snap the materialization up front for that
+        # shape alone.
+        requests = None
+        if (
+            getattr(enc, "_requests", 0) is None
+            and getattr(enc, "_cols", 0) is None
+        ):
+            requests = enc.requests
         try:
             results = self.primary.decode_launched(launched)
         except Exception as e:
             self._record_failure(e)
-            return self._fallback_check(requests, 0, depths)
+            return self._fallback_check(
+                requests if requests is not None else enc.requests,
+                0,
+                depths,
+            )
         if not _valid_batch(results, n):
             self._record_failure(None)
-            return self._fallback_check(requests, 0, depths)
+            return self._fallback_check(
+                requests if requests is not None else enc.requests,
+                0,
+                depths,
+            )
         self._record_success()
         return [bool(v) for v in results]
+
+    def batch_check_columns(
+        self, cols, max_depth: int = 0, depths=None
+    ) -> list[bool]:
+        """Columnar twin of batch_check: the primary answers straight from
+        the columns; ``RelationTuple`` objects are built lazily ONLY when
+        the breaker is open or the primary's answer is invalid and the
+        host oracle must re-answer the batch."""
+        n = len(cols)
+        if not n:
+            return []
+        run = getattr(self.primary, "batch_check_columns", None)
+        if run is None:
+            return self.batch_check(cols.materialize(), max_depth, depths)
+        if self._use_primary():
+            try:
+                results = run(cols, max_depth, depths)
+            except Exception as e:
+                self._record_failure(e)
+                return self._fallback_check(
+                    cols.materialize(), max_depth, depths
+                )
+            if not _valid_batch(results, n):
+                self._record_failure(None)
+                return self._fallback_check(
+                    cols.materialize(), max_depth, depths
+                )
+            self._record_success()
+            return [bool(v) for v in results]
+        return self._fallback_check(cols.materialize(), max_depth, depths)
 
     def _fallback_check(self, requests, max_depth, depths) -> list[bool]:
         if self._m_fallback_batches is not None:

@@ -1,3 +1,4 @@
+from .columns import CheckColumns, proto_has_columns
 from .definitions import (
     Manager,
     ManagerWrapper,
@@ -13,6 +14,7 @@ from .definitions import (
 )
 
 __all__ = [
+    "CheckColumns",
     "Manager",
     "ManagerWrapper",
     "RelationQuery",
@@ -21,6 +23,7 @@ __all__ = [
     "SubjectID",
     "SubjectSet",
     "parse_tuples_text",
+    "proto_has_columns",
     "relation_collection_table",
     "subject_from_dict",
     "subject_from_string",
