@@ -1129,6 +1129,55 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
             stub.Check(req0)
             mux_lat.append(time.perf_counter() - t0)
 
+    # tail-latency / deadline phase: serial deadline-bounded singles while
+    # injected stalls hit ~5% of requests — measures the latency tail the
+    # deadline machinery exists to bound, and the miss rate
+    # (DEADLINE_EXCEEDED answers) those stalls produce. Both slowness
+    # seams are armed: device.slow (kernel launch; device query mode) and
+    # replica.slow (servicer entry; fires in any mode). Fresh samples, not
+    # the hot pool: a result-cache hit never reaches the device seam.
+    from keto_tpu.faults import FAULTS as _FAULTS
+
+    tail_n = int(os.environ.get("BENCH_TAIL_N", 400))
+    tail_deadline_ms = float(os.environ.get("BENCH_TAIL_DEADLINE_MS", 50.0))
+    tail_slow_every = 20
+    tail_sites = ("device.slow", "replica.slow")
+    tail_blobs = serialize_singles(tail_n)
+    tail_lat = []
+    tail_misses = 0
+    fired_before = sum(_FAULTS.fired(s) for s in tail_sites)
+    with grpc.insecure_channel(f"127.0.0.1:{grpc_direct}") as ch:
+        stub = CheckServiceStub(ch)
+        stub.Check(req0)  # warm the channel
+        for i, blob in enumerate(tail_blobs):
+            if i % tail_slow_every == 0:
+                # one stall longer than the budget: the request riding it
+                # must miss its deadline, not just run late
+                for site in tail_sites:
+                    _FAULTS.arm_slow(
+                        site, sleep_ms=tail_deadline_ms * 1.6, times=1
+                    )
+            t0 = time.perf_counter()
+            try:
+                stub.Check(
+                    check_service_pb2.CheckRequest.FromString(blob),
+                    timeout=tail_deadline_ms / 1000.0,
+                )
+            except grpc.RpcError as e:
+                if e.code() != grpc.StatusCode.DEADLINE_EXCEEDED:
+                    raise
+                tail_misses += 1
+            tail_lat.append(time.perf_counter() - t0)
+    for site in tail_sites:
+        _FAULTS.disarm(site)
+    tail_slow_fired = sum(_FAULTS.fired(s) for s in tail_sites) - fired_before
+    # server-side view of the same phase: per-stage deadline culls prove
+    # the expired work was dropped in the pipe, not answered late
+    try:
+        tail_culls = reg.checker().pipeline_stats().get("deadline_expired", {})
+    except Exception:
+        tail_culls = {}
+
     asyncio.run_coroutine_threadsafe(reg.stop_all(), loop).result(timeout=30)
     loop.call_soon_threadsafe(loop.stop)
     loop_thread.join(timeout=10)
@@ -1197,6 +1246,16 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
         ),
         "columnar_parity": "ok",  # asserted above: gRPC cols == tuples == REST cols
         "mux_grpc_p50_ms": round(1000 * float(np.percentile(mux_lat, 50)), 2),
+        # tail phase: deadline-bounded singles under injected device.slow
+        # stalls (p999 over BENCH_TAIL_N serial samples ~= the max)
+        "tail_n": tail_n,
+        "tail_deadline_ms": tail_deadline_ms,
+        "tail_slow_faults_fired": tail_slow_fired,
+        "tail_p50_ms": round(1000 * float(np.percentile(tail_lat, 50)), 2),
+        "tail_p99_ms": round(1000 * float(np.percentile(tail_lat, 99)), 2),
+        "tail_p999_ms": round(1000 * float(np.percentile(tail_lat, 99.9)), 2),
+        "tail_deadline_miss_rate": round(tail_misses / max(1, tail_n), 4),
+        "tail_server_culls": tail_culls,
     }
     return out
 
@@ -1222,6 +1281,7 @@ def _smoke_defaults() -> None:
         "BENCH_SERVER_PROCS": "1",
         "BENCH_SERVER_WORKERS": "2",
         "BENCH_WRITE_CYCLES": "3",
+        "BENCH_TAIL_N": "120",
         "BENCH_SHARDED": "0",
         "BENCH_BUDGET_S": "240",
         "BENCH_PROBE_TIMEOUT_S": "20",

@@ -181,6 +181,7 @@ def build_read_grpc_server(
     health: HealthServicer, max_workers: int = 32,
     logger=None, metrics=None, tracer=None,
     max_message_bytes: int = 0,
+    max_freshness_wait_s=30.0,  # float or zero-arg callable (hot reload)
 ) -> grpc.Server:
     """Read-plane gRPC: Check + Expand + Read + Version + Health +
     reflection, behind the telemetry interceptor chain (reference
@@ -194,7 +195,12 @@ def build_read_grpc_server(
         options=grpc_message_options(max_message_bytes),
     )
     server._keto_executor = executor  # joined by PlaneServer.stop
-    add_check_service(server, CheckServicer(checker, snaptoken_fn))
+    add_check_service(
+        server,
+        CheckServicer(
+            checker, snaptoken_fn, max_freshness_wait_s=max_freshness_wait_s
+        ),
+    )
     add_expand_service(server, ExpandServicer(expand_engine, snaptoken_fn))
     add_read_service(server, ReadServicer(manager))
     add_version_service(server, VersionServicer(version))

@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
+from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Optional
 
 from aiohttp import web
@@ -42,7 +44,7 @@ from ..relationtuple.definitions import (
     SubjectID,
     SubjectSet,
 )
-from ..utils.errors import ErrMalformedInput, KetoError
+from ..utils.errors import DeadlineExceeded, ErrMalformedInput, KetoError
 from ..utils.pagination import PaginationOptions
 from .convert import min_version_from
 
@@ -50,6 +52,28 @@ ROUTE_TUPLES = "/relation-tuples"
 ROUTE_CHECK = "/check"
 ROUTE_CHECK_BATCH = "/check/batch"
 ROUTE_EXPAND = "/expand"
+
+#: the REST spelling of a gRPC deadline: milliseconds of budget the caller
+#: grants this request, measured from when the header is parsed
+DEADLINE_HEADER = "X-Request-Deadline-Ms"
+
+
+def deadline_from_headers(request: web.Request) -> Optional[float]:
+    """Parse :data:`DEADLINE_HEADER` into an absolute ``time.monotonic()``
+    deadline (None when absent). A non-numeric or negative value is the
+    caller's bug: 400, not a silently ignored header."""
+    raw = request.headers.get(DEADLINE_HEADER)
+    if raw is None:
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        raise ErrMalformedInput(
+            f"{DEADLINE_HEADER} must be a number of milliseconds, got {raw!r}"
+        ) from None
+    if ms < 0:
+        raise ErrMalformedInput(f"{DEADLINE_HEADER} must be >= 0, got {raw!r}")
+    return time.monotonic() + ms / 1000.0
 
 
 def _json_error(err: KetoError) -> web.Response:
@@ -72,6 +96,10 @@ async def error_middleware(request: web.Request, handler):
         return _json_error(e)
     except web.HTTPException:
         raise
+    except (asyncio.TimeoutError, _FutTimeout, TimeoutError):
+        # a timeout that escaped typed handling is still "the request ran
+        # out of time", not a server bug: 504, not 500
+        return _json_error(DeadlineExceeded())
     except Exception as e:  # internal
         return web.json_response(
             {
@@ -299,7 +327,7 @@ class ReadAPI:
         p = request.rel_url.query
         tup = _tuple_from_query(p)
         return await self._check_response(
-            tup, max_depth_from_query(p), _min_version_from_query(p)
+            request, tup, max_depth_from_query(p), _min_version_from_query(p)
         )
 
     async def post_check(self, request: web.Request) -> web.Response:
@@ -307,7 +335,7 @@ class ReadAPI:
         tup = RelationTuple.from_dict(body)
         p = request.rel_url.query
         return await self._check_response(
-            tup, max_depth_from_query(p), _min_version_from_query(p)
+            request, tup, max_depth_from_query(p), _min_version_from_query(p)
         )
 
     async def post_check_batch(self, request: web.Request) -> web.Response:
@@ -324,6 +352,9 @@ class ReadAPI:
         p = request.rel_url.query
         max_depth = max_depth_from_query(p)
         min_version = _min_version_from_query(p)
+        deadline = deadline_from_headers(request)
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DeadlineExceeded()
         if isinstance(body, dict) and "namespaces" in body:
             cols = CheckColumns.from_rest_body(body)
             max_depth = int(body.get("max_depth", max_depth) or max_depth)
@@ -355,7 +386,7 @@ class ReadAPI:
         allowed = await asyncio.get_running_loop().run_in_executor(
             self.executor,
             lambda: self.checker.check_batch(
-                tuples, max_depth, min_version=min_version
+                tuples, max_depth, min_version=min_version, deadline=deadline
             ),
         )
         return web.json_response(
@@ -363,16 +394,35 @@ class ReadAPI:
         )
 
     async def _check_response(
-        self, tup: RelationTuple, max_depth: int, min_version: int = 0
+        self,
+        request: web.Request,
+        tup: RelationTuple,
+        max_depth: int,
+        min_version: int = 0,
     ) -> web.Response:
+        deadline = deadline_from_headers(request)
+        # entry_hook hands back the batcher future so a client disconnect
+        # (this coroutine cancelled) can cancel it — the next pipeline
+        # stage boundary then frees the batch slot instead of paying
+        # device time for a caller that is gone
+        entries: list = []
         # the check blocks on device compute (or the batcher window) — run it
         # off the event loop so concurrent requests accumulate into batches
-        allowed = await asyncio.get_running_loop().run_in_executor(
-            self.executor,
-            lambda: self.checker.check(
-                tup, max_depth, min_version=min_version
-            ),
-        )
+        try:
+            allowed = await asyncio.get_running_loop().run_in_executor(
+                self.executor,
+                lambda: self.checker.check(
+                    tup,
+                    max_depth,
+                    min_version=min_version,
+                    deadline=deadline,
+                    entry_hook=entries.append,
+                ),
+            )
+        except asyncio.CancelledError:
+            for f in entries:
+                f.cancel()
+            raise
         # 200 when allowed, 403 when denied — both carry the body
         # (reference check/handler.go:120-139)
         return web.json_response(

@@ -18,13 +18,15 @@ was computed at.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Iterator, Optional
 
 import grpc
 
+from ..faults import FAULTS
 from ..relationtuple.columns import CheckColumns, proto_has_columns
 from ..relationtuple.definitions import RelationQuery, RelationTuple
-from ..utils.errors import ErrMalformedInput, KetoError
+from ..utils.errors import DeadlineExceeded, ErrMalformedInput, KetoError
 from ..utils.pagination import PaginationOptions
 from . import (
     acl_pb2,
@@ -64,11 +66,23 @@ def _abort(context: grpc.ServicerContext, err: Exception):
 class CheckServicer:
     """`checker` is anything with check(tuple, max_depth) -> bool (a
     CheckBatcher or a _DirectChecker); snaptoken_fn yields the current store
-    version."""
+    version. ``max_freshness_wait_s`` caps any snaptoken catch-up wait —
+    a float, or a zero-arg callable read per request (hot-reloadable
+    config knob ``serve.read.max_freshness_wait_s``)."""
 
-    def __init__(self, checker, snaptoken_fn: Callable[[], str]):
+    def __init__(
+        self,
+        checker,
+        snaptoken_fn: Callable[[], str],
+        max_freshness_wait_s=30.0,
+    ):
         self.checker = checker
         self.snaptoken_fn = snaptoken_fn
+        self._freshness_cap = max_freshness_wait_s
+
+    def _freshness_cap_s(self) -> float:
+        cap = self._freshness_cap
+        return float(cap()) if callable(cap) else float(cap)
 
     def pipeline_stats(self) -> dict:
         """Dispatch-pipeline occupancy of the backing checker (queue
@@ -79,6 +93,10 @@ class CheckServicer:
 
     def Check(self, request, context):
         try:
+            # fault site: THIS replica answers slowly (per-process — each
+            # forked replica owns its registry copy); the seam hedged
+            # client reads exist to mask
+            FAULTS.maybe_sleep("replica.slow")
             subject = subject_from_proto(
                 request.subject if request.HasField("subject") else None
             )
@@ -99,13 +117,27 @@ class CheckServicer:
             # bound any freshness wait by the RPC deadline (capped):
             # pinning a server thread past the client's own deadline only
             # wastes it
+            cap = self._freshness_cap_s()
             remaining = context.time_remaining()
-            timeout = 30.0 if remaining is None else min(remaining, 30.0)
+            timeout = cap if remaining is None else min(remaining, cap)
+            # propagate the caller's absolute deadline so the batcher can
+            # reject dead-on-arrival work and cull mid-pipeline expiry;
+            # RPC termination (client gone) cancels the queued entry so
+            # its batch slot frees at the next stage boundary
+            deadline = (
+                None if remaining is None else time.monotonic() + remaining
+            )
+            entries: list = []
+            context.add_callback(
+                lambda: [f.cancel() for f in entries]
+            )
             allowed = self.checker.check(
                 tup,
                 request.max_depth,
                 timeout=timeout,
                 min_version=min_version,
+                deadline=deadline,
+                entry_hook=entries.append,
             )
             return check_service_pb2.CheckResponse(
                 allowed=allowed, snaptoken=self.snaptoken_fn()
@@ -119,8 +151,12 @@ class CheckServicer:
         columns, fields 5-11) skip per-tuple object construction entirely:
         the columns flow straight to the batcher's vocab/bulk-hash path."""
         try:
+            cap = self._freshness_cap_s()
             remaining = context.time_remaining()
-            timeout = 30.0 if remaining is None else min(remaining, 30.0)
+            timeout = cap if remaining is None else min(remaining, cap)
+            deadline = (
+                None if remaining is None else time.monotonic() + remaining
+            )
             min_version = min_version_from(request.snaptoken, request.latest)
             if proto_has_columns(request):
                 cols = CheckColumns.from_proto(request)
@@ -164,6 +200,7 @@ class CheckServicer:
                 request.max_depth,
                 min_version=min_version,
                 timeout=timeout,
+                deadline=deadline,
             )
             return check_service_pb2.BatchCheckResponse(
                 allowed=allowed, snaptoken=self.snaptoken_fn()
@@ -550,10 +587,14 @@ class _DirectChecker:
         max_depth: int = 0,
         timeout: Optional[float] = None,
         min_version: int = 0,
+        deadline: Optional[float] = None,
+        entry_hook=None,
     ) -> bool:
         # the direct engines answer from live data (host oracle) or
         # rebuild synchronously, so any min_version is already satisfied
-        del timeout, min_version
+        del timeout, min_version, entry_hook
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DeadlineExceeded()
         return self.engine.subject_is_allowed(request, max_depth)
 
     def check_batch(
@@ -562,10 +603,13 @@ class _DirectChecker:
         max_depth: int = 0,
         min_version: int = 0,
         timeout: Optional[float] = None,
+        deadline: Optional[float] = None,
     ) -> list:
         from ..engine.batcher import dispatch_batched
 
         del min_version, timeout  # direct engines answer from live data
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DeadlineExceeded()
         return dispatch_batched(
             self.engine, requests, max_depth, self.max_batch
         )

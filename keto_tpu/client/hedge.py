@@ -1,0 +1,179 @@
+"""Client-side hedged reads: reissue a slow single check to a second
+replica and take whichever answer lands first.
+
+The replica pool serves every worker on ONE port via SO_REUSEPORT, so a
+client cannot address "the other replica" directly — but a NEW connection
+is load-balanced by the kernel, which is exactly the reissue path hedging
+needs. The tail-latency argument is the classic one (Dean & Barroso, "The
+Tail at Scale"): when one replica is briefly slow (GC pause, delta drain,
+an injected ``replica.slow`` fault), a duplicate request to a second
+replica converts the p99 into roughly the p50 at the cost of a few percent
+extra load — provided the hedge fires only after the request has already
+outlived the typical latency.
+
+Semantics, in the order they matter:
+
+- **At most one hedge per request.** A request that outlives the hedge
+  delay gets exactly one duplicate; the loser's answer is discarded.
+  Checks are read-only so duplicate execution is harmless.
+- **Hedge delay defaults to an online estimate**: a high quantile of
+  recently observed latencies (times a safety multiplier), so the hedge
+  fires for outliers only and the duplicate-load fraction stays pinned
+  near ``1 - quantile``. A fixed ``delay_s`` overrides the estimate.
+- **First answer wins; first error does not.** If the winner raised, the
+  other attempt's answer is awaited — a hedge exists to mask slowness,
+  not to double the error rate. Both failing raises the primary's error.
+- Counters (telemetry.metrics.hedge_counters): ``fired`` = a hedge was
+  issued, ``won`` = the hedge answered first, ``wasted`` = the primary
+  answered first so the hedge's work was thrown away.
+
+``clock`` and the executor are injectable so tests drive the schedule
+deterministically (same pattern as client/retry.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Callable, Optional
+
+
+class HedgePolicy:
+    """When to hedge: a fixed ``delay_s``, or (default) an online estimate —
+    the ``quantile`` of the last ``window`` observed latencies times
+    ``multiplier``, clamped to [min_delay_s, max_delay_s]. Until enough
+    latencies are observed (``min_samples``), ``max_delay_s`` is used, so a
+    cold client does not hedge on its very first requests."""
+
+    def __init__(
+        self,
+        delay_s: Optional[float] = None,
+        quantile: float = 0.95,
+        multiplier: float = 1.0,
+        min_delay_s: float = 0.001,
+        max_delay_s: float = 1.0,
+        window: int = 512,
+        min_samples: int = 10,
+    ):
+        self.delay_s = delay_s
+        self.quantile = min(1.0, max(0.0, quantile))
+        self.multiplier = multiplier
+        self.min_delay_s = min_delay_s
+        self.max_delay_s = max_delay_s
+        self.window = max(1, window)
+        self.min_samples = max(1, min_samples)
+        self._latencies: list[float] = []
+        self._idx = 0  # ring-buffer cursor once the window is full
+        self._lock = threading.Lock()
+
+    def observe(self, latency_s: float) -> None:
+        """Record one request's time-to-first-answer (hedged or not)."""
+        with self._lock:
+            if len(self._latencies) < self.window:
+                self._latencies.append(latency_s)
+            else:
+                self._latencies[self._idx] = latency_s
+                self._idx = (self._idx + 1) % self.window
+
+    def current_delay_s(self) -> float:
+        if self.delay_s is not None:
+            return self.delay_s
+        with self._lock:
+            lat = list(self._latencies)
+        if len(lat) < self.min_samples:
+            return self.max_delay_s
+        lat.sort()
+        q = lat[min(len(lat) - 1, int(self.quantile * len(lat)))]
+        return min(
+            self.max_delay_s, max(self.min_delay_s, q * self.multiplier)
+        )
+
+
+class HedgedCall:
+    """Outcome of one hedged request: the answer plus what the hedge did."""
+
+    __slots__ = ("result", "hedged", "hedge_won", "elapsed_s")
+
+    def __init__(self, result, hedged: bool, hedge_won: bool, elapsed_s: float):
+        self.result = result
+        self.hedged = hedged  # a duplicate was issued
+        self.hedge_won = hedge_won  # ... and its answer was used
+        self.elapsed_s = elapsed_s  # time to the answer actually used
+
+
+class Hedger:
+    """Runs zero-arg callables with hedging. ``counters`` is the (fired,
+    won, wasted) triple from telemetry.metrics.hedge_counters (or None).
+    Owns a small executor unless one is injected; the two attempts of one
+    request need two concurrent slots, so size accordingly."""
+
+    def __init__(
+        self,
+        policy: Optional[HedgePolicy] = None,
+        counters=None,
+        executor: Optional[ThreadPoolExecutor] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.policy = policy or HedgePolicy()
+        self._counters = counters
+        self._own_executor = executor is None
+        self._executor = executor or ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="hedge"
+        )
+        self._clock = clock
+
+    def close(self) -> None:
+        if self._own_executor:
+            # abandoned losers may still be in flight; don't join them
+            self._executor.shutdown(wait=False, cancel_futures=True)
+
+    def __enter__(self) -> "Hedger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _inc(self, which: int) -> None:
+        if self._counters is not None:
+            self._counters[which].inc()
+
+    def call(
+        self,
+        primary: Callable[[], object],
+        hedge: Optional[Callable[[], object]] = None,
+    ) -> HedgedCall:
+        """Run ``primary()``; if no answer within the policy's hedge delay,
+        also run ``hedge()`` (defaults to ``primary`` — the reissue-to-pool
+        case) and return whichever answers first. At most one hedge."""
+        start = self._clock()
+        f_primary = self._executor.submit(primary)
+        delay = self.policy.current_delay_s()
+        done, _ = wait((f_primary,), timeout=delay)
+        if done:
+            elapsed = self._clock() - start
+            self.policy.observe(elapsed)
+            return HedgedCall(f_primary.result(), False, False, elapsed)
+        self._inc(0)  # fired
+        f_hedge = self._executor.submit(hedge or primary)
+        pair = {f_primary, f_hedge}
+        winner = None
+        while pair:
+            done, pair = wait(pair, return_when=FIRST_COMPLETED)
+            for f in done:
+                if f.exception() is None and winner is None:
+                    winner = f
+            if winner is not None:
+                break
+        if winner is None:
+            # both attempts failed: surface the primary's error — the
+            # hedge was a duplicate of it, not a different question
+            elapsed = self._clock() - start
+            self.policy.observe(elapsed)
+            self._inc(2)  # wasted (it bought nothing)
+            raise f_primary.exception()
+        elapsed = self._clock() - start
+        self.policy.observe(elapsed)
+        hedge_won = winner is f_hedge
+        self._inc(1 if hedge_won else 2)  # won / wasted
+        return HedgedCall(winner.result(), True, hedge_won, elapsed)

@@ -83,6 +83,9 @@ _PORT_SCHEMA = {
         # the cmd-side clients); large columnar BatchCheck payloads exceed
         # grpc's 4 MiB default. 0 = leave the grpc default
         "grpc-max-message-size": {"type": "integer", "minimum": 0},
+        # read plane only: cap on any snaptoken freshness wait (seconds) —
+        # hot-reloadable (HOT_SERVE_KEYS), unlike the rest of serve
+        "max_freshness_wait_s": {"type": "number", "minimum": 0},
     },
     "additionalProperties": True,
 }
@@ -218,6 +221,7 @@ DEFAULTS = {
     "serve.read.max-depth": 5,
     "serve.read.workers": 1,
     "serve.read.grpc-max-message-size": 64 << 20,
+    "serve.read.max_freshness_wait_s": 30.0,
     "serve.write.port": 4467,
     "serve.write.host": "",
     "serve.write.grpc-max-message-size": 64 << 20,
@@ -271,6 +275,53 @@ def load_config_file(path: str) -> dict:
 # ignored with a warning (reference provider.go:70 immutable settings)
 IMMUTABLE_KEYS = ("dsn", "serve")
 
+# carve-outs from the immutable ``serve`` block: tuning knobs that are safe
+# to flip on a live server (no socket rebinds, no topology change). reload()
+# grafts the fresh values into the otherwise-frozen boot subtree.
+HOT_SERVE_KEYS = ("serve.read.max_freshness_wait_s",)
+
+_HOT_MISSING = object()
+
+
+def _dig(data: dict, parts: list[str]):
+    cur: Any = data
+    for p in parts:
+        if not isinstance(cur, dict) or p not in cur:
+            return _HOT_MISSING
+        cur = cur[p]
+    return cur
+
+
+def _graft(data: dict, parts: list[str], value: Any) -> None:
+    cur = data
+    for p in parts[:-1]:
+        nxt = cur.get(p)
+        if not isinstance(nxt, dict):
+            nxt = {}
+        else:
+            nxt = dict(nxt)  # copy: boot subtree is shared, don't mutate it
+        cur[p] = nxt
+        cur = nxt
+    if value is _HOT_MISSING:
+        cur.pop(parts[-1], None)
+    else:
+        cur[parts[-1]] = value
+
+
+def _strip_hot(block: Any, prefix: str) -> Any:
+    """Copy of a top-level config block with its HOT_SERVE_KEYS removed,
+    for change comparison — a serve diff confined to hot knobs must not
+    trip the immutability warning."""
+    if not isinstance(block, dict):
+        return block
+    out = json.loads(json.dumps(block))  # deep copy, config is plain JSON
+    for dotted in HOT_SERVE_KEYS:
+        top, _, rest = dotted.partition(".")
+        if top != prefix:
+            continue
+        _graft(out, rest.split("."), _HOT_MISSING)
+    return out
+
 
 class Config:
     def __init__(
@@ -323,6 +374,10 @@ class Config:
         applied = []
         for key in changed:
             if key in IMMUTABLE_KEYS:
+                if _strip_hot(old.get(key), key) == _strip_hot(
+                    fresh.get(key), key
+                ):
+                    continue  # diff confined to hot knobs, handled below
                 # frozen after boot — say so, or the operator believes the
                 # new DSN/ports are live
                 from ..telemetry import get_logger
@@ -340,6 +395,14 @@ class Config:
                 merged[key] = old[key]
             else:
                 merged.pop(key, None)
+        # hot carve-outs: graft the fresh values of HOT_SERVE_KEYS into the
+        # frozen boot subtree so these knobs really are live-reloadable
+        for dotted in HOT_SERVE_KEYS:
+            parts = dotted.split(".")
+            new_v = _dig(fresh, parts)
+            if new_v != _dig(old, parts):
+                _graft(merged, parts, new_v)
+                applied.append(dotted)
         self._data = merged
         if "namespaces" in applied:
             self._refresh_namespace_manager()

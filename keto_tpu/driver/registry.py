@@ -263,6 +263,15 @@ class Registry:
                 )
         return self._expand_engine
 
+    def _freshness_cap_s(self) -> float:
+        """Live value of the freshness-wait cap — passed as a CALLABLE into
+        the batcher and servicers so config hot-reloads apply to in-flight
+        servers (serve.read.max_freshness_wait_s is a HOT_SERVE_KEYS
+        carve-out from the frozen serve block)."""
+        return float(
+            self.config.get("serve.read.max_freshness_wait_s", default=30.0)
+        )
+
     def checker(self):
         """The check entry point handlers use: batched on the device path,
         direct on the host path."""
@@ -331,6 +340,7 @@ class Registry:
                             "engine.encoded_cache_size", default=65536
                         )
                     ),
+                    max_freshness_wait_s=self._freshness_cap_s,
                 )
                 self._checker = self._batcher
         return self._checker
@@ -400,6 +410,7 @@ class Registry:
                 max_message_bytes=int(
                     self.config.get("serve.read.grpc-max-message-size")
                 ),
+                max_freshness_wait_s=self._freshness_cap_s,
             )
             app = build_read_app(
                 self.store(),

@@ -415,6 +415,10 @@ class ReplicaPool:
         )
         with self._log_lock:
             self._delta_log.append((version, payload))
+        # fault site: stall delta propagation (replica staleness window) —
+        # the slow analogue of delta.drop; freshness waits on the replicas
+        # stretch until this returns
+        FAULTS.maybe_sleep("delta.slow")
         # fault site: silently skip this frame for ONE serving replica —
         # the version gap the resync handshake exists to detect and fill
         drop_one = FAULTS.should_fire("delta.drop")

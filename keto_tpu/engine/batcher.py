@@ -51,12 +51,21 @@ import queue as _queue_mod
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Optional, Sequence
 
 from ..faults import FAULTS
 from ..relationtuple.definitions import RelationTuple
-from ..telemetry.metrics import pipeline_stage_histogram
-from ..utils.errors import ErrInternal, ErrResourceExhausted, ErrUnavailable
+from ..telemetry.metrics import (
+    deadline_expired_counter,
+    pipeline_stage_histogram,
+)
+from ..utils.errors import (
+    DeadlineExceeded,
+    ErrInternal,
+    ErrResourceExhausted,
+    ErrUnavailable,
+)
 
 
 class BatcherClosed(ErrUnavailable):
@@ -93,7 +102,8 @@ class _PBatch:
     __slots__ = ("items", "enc", "launched", "keys", "t_encoded")
 
     def __init__(self, items):
-        self.items = items  # [(request, depth, Future, t_enqueued), ...]
+        # [(request, depth, Future, t_enqueued, deadline), ...]
+        self.items = items
         self.enc = None  # EncodedBatch after the encode stage
         self.launched = None  # LaunchedBatch after the launch stage
         self.keys = None  # encoded-cache keys (when the cache is on)
@@ -126,6 +136,9 @@ class CheckBatcher:
         pipeline_depth: int = 0,  # 0 -> serial dispatch (one batch in flight)
         encode_workers: int = 2,
         encoded_cache_size: int = 0,  # 0 disables the encoded-request cache
+        # snaptoken catch-up cap: float, or a zero-arg callable for a
+        # hot-reloadable knob (serve.read.max_freshness_wait_s)
+        max_freshness_wait_s=30.0,
     ):
         self.engine = engine
         self.max_batch = max_batch
@@ -133,6 +146,7 @@ class CheckBatcher:
         self.cache = cache
         self.version_fn = version_fn
         self.max_queue = max_queue if max_queue > 0 else 8 * max_batch
+        self._max_freshness_wait_s = max_freshness_wait_s
         self._logger = logger
         self.pipeline_depth = pipeline_depth
         self.encode_workers = max(1, encode_workers)
@@ -160,6 +174,13 @@ class CheckBatcher:
         self._m_restarts = None
         self._m_stage = None
         self._m_columnar = None
+        self._m_deadline = None
+        self._m_cancelled = None
+        # per-stage cull tallies mirrored outside the metrics registry so
+        # pipeline_stats() (the /pipeline endpoint) can surface them even
+        # on metric-less builds
+        self._cull_expired_counts: dict[str, int] = {}
+        self._cull_cancelled_counts: dict[str, int] = {}
         if metrics is not None:
             self._m_batch_size = metrics.histogram(
                 "keto_batcher_batch_size",
@@ -178,6 +199,13 @@ class CheckBatcher:
                 "keto_batcher_columnar_batches_total",
                 "caller-assembled batches served through the columnar "
                 "zero-object path",
+            )
+            self._m_deadline = deadline_expired_counter(metrics)
+            self._m_cancelled = metrics.counter(
+                "keto_check_cancelled_total",
+                "check requests dropped because the caller disconnected "
+                "before an answer, labeled by the stage that freed the slot",
+                labelnames=("stage",),
             )
             metrics.gauge(
                 "keto_batcher_queue_depth",
@@ -266,15 +294,31 @@ class CheckBatcher:
             t.start()
         return threads
 
+    def max_freshness_wait_s(self) -> float:
+        """Current freshness-wait cap; resolves the hot-reload callable."""
+        cap = self._max_freshness_wait_s
+        return float(cap() if callable(cap) else cap)
+
     def check(
         self,
         request: RelationTuple,
         max_depth: int = 0,
         timeout: Optional[float] = None,
         min_version: int = 0,
+        deadline: Optional[float] = None,  # absolute time.monotonic() secs
+        entry_hook=None,  # called with the entry Future after enqueue —
+        # transports hold it to cancel on client disconnect
     ) -> bool:
         if self._closed:
             raise BatcherClosed()
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                # already dead on arrival: reject before the queue, the
+                # cache, or any engine work is touched
+                self._note_expired("admission", 1)
+                raise DeadlineExceeded()
+            timeout = remaining if timeout is None else min(timeout, remaining)
         if min_version > 0:
             # at-least-as-fresh consistency (CheckRequest.snaptoken): make
             # the serving snapshot catch up before answering. The cache is
@@ -283,8 +327,16 @@ class CheckBatcher:
             if wait is not None:
                 wait(
                     min_version,
-                    timeout_s=timeout if timeout is not None else 30.0,
+                    timeout_s=(
+                        timeout
+                        if timeout is not None
+                        else self.max_freshness_wait_s()
+                    ),
                 )
+            if deadline is not None and time.monotonic() >= deadline:
+                # the freshness wait consumed the whole budget
+                self._note_expired("admission", 1)
+                raise DeadlineExceeded()
         if self.cache is not None:
             version = self.version_fn()
             key = (request, max_depth)
@@ -303,9 +355,22 @@ class CheckBatcher:
                 if self._m_shed is not None:
                     self._m_shed.inc()
                 raise BatcherOverloaded()
-            self._queue.append((request, max_depth, f, time.perf_counter()))
+            self._queue.append(
+                (request, max_depth, f, time.perf_counter(), deadline)
+            )
             self._cv.notify()
-        result = f.result(timeout=timeout)
+        if entry_hook is not None:
+            entry_hook(f)
+        try:
+            result = f.result(timeout=timeout)
+        except _FutTimeout:
+            if deadline is not None and time.monotonic() >= deadline:
+                # the caller's budget ran out while the entry was still in
+                # the pipe: cancel it so the next stage boundary frees the
+                # slot instead of paying device time for a dead request
+                f.cancel()
+                raise DeadlineExceeded() from None
+            raise
         if self.cache is not None:
             self.cache.put(version, key, result)
         return result
@@ -316,6 +381,7 @@ class CheckBatcher:
         max_depth: int = 0,
         min_version: int = 0,
         timeout: Optional[float] = None,
+        deadline: Optional[float] = None,
     ) -> list[bool]:
         """A caller-assembled batch: already amortized, so it skips the
         queue and dispatches directly (the batch-check transport path).
@@ -326,13 +392,26 @@ class CheckBatcher:
         an engine dispatch."""
         if self._closed:
             raise BatcherClosed()
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._note_expired("admission", 1)
+                raise DeadlineExceeded()
+            timeout = remaining if timeout is None else min(timeout, remaining)
         if min_version > 0:
             wait = getattr(self.engine, "wait_for_version", None)
             if wait is not None:
                 wait(
                     min_version,
-                    timeout_s=timeout if timeout is not None else 30.0,
+                    timeout_s=(
+                        timeout
+                        if timeout is not None
+                        else self.max_freshness_wait_s()
+                    ),
                 )
+            if deadline is not None and time.monotonic() >= deadline:
+                self._note_expired("admission", 1)
+                raise DeadlineExceeded()
         if self.cache is None:
             return dispatch_batched(
                 self.engine, requests, max_depth, self.max_batch
@@ -380,7 +459,11 @@ class CheckBatcher:
             if wait is not None:
                 wait(
                     min_version,
-                    timeout_s=timeout if timeout is not None else 30.0,
+                    timeout_s=(
+                        timeout
+                        if timeout is not None
+                        else self.max_freshness_wait_s()
+                    ),
                 )
         if self._m_columnar is not None:
             self._m_columnar.inc()
@@ -489,7 +572,11 @@ class CheckBatcher:
             if wait is not None:
                 wait(
                     min_version,
-                    timeout_s=timeout if timeout is not None else 30.0,
+                    timeout_s=(
+                        timeout
+                        if timeout is not None
+                        else self.max_freshness_wait_s()
+                    ),
                 )
         import numpy as np
 
@@ -599,11 +686,16 @@ class CheckBatcher:
     def pipeline_stats(self) -> dict:
         """Queue/stage occupancy snapshot — surfaced by the read plane's
         stats endpoints so pipeline health is observable without scraping."""
+        with self._lock:
+            expired = dict(self._cull_expired_counts)
+            cancelled = dict(self._cull_cancelled_counts)
         out = {
             "pipelined": self.pipelined,
             "queue_depth": len(self._queue),
             "max_queue": self.max_queue,
             "max_batch": self.max_batch,
+            "deadline_expired": expired,
+            "cancelled": cancelled,
         }
         if self.pipelined:
             with self._lock:
@@ -652,6 +744,54 @@ class CheckBatcher:
         if self._m_stage is not None:
             self._m_stage.labels(stage=stage).observe(seconds)
 
+    # -- deadline / cancellation culling ---------------------------------------
+
+    def _note_expired(self, stage: str, n: int) -> None:
+        if self._m_deadline is not None:
+            self._m_deadline.labels(stage=stage).inc(n)
+        with self._lock:
+            self._cull_expired_counts[stage] = (
+                self._cull_expired_counts.get(stage, 0) + n
+            )
+
+    def _note_cancelled(self, stage: str, n: int) -> None:
+        if self._m_cancelled is not None:
+            self._m_cancelled.labels(stage=stage).inc(n)
+        with self._lock:
+            self._cull_cancelled_counts[stage] = (
+                self._cull_cancelled_counts.get(stage, 0) + n
+            )
+
+    def _cull(self, items: list, stage: str) -> tuple[list, list[int]]:
+        """Drop entries whose caller gave up — deadline passed (their
+        future fails typed with :class:`DeadlineExceeded`) or future
+        cancelled on client disconnect — so the stage ahead never pays
+        for them. Returns (kept entries, their indices in ``items``);
+        the index list lets the launch stage compact staged device
+        buffers in the same motion."""
+        now = time.monotonic()
+        kept: list = []
+        keep_idx: list[int] = []
+        expired = cancelled = 0
+        for i, it in enumerate(items):
+            f = it[2]
+            if f.cancelled():
+                cancelled += 1
+                continue
+            dl = it[4]
+            if dl is not None and now >= dl:
+                if not f.done():
+                    f.set_exception(DeadlineExceeded())
+                expired += 1
+                continue
+            kept.append(it)
+            keep_idx.append(i)
+        if expired:
+            self._note_expired(stage, expired)
+        if cancelled:
+            self._note_cancelled(stage, cancelled)
+        return kept, keep_idx
+
     # -- serial dispatcher ---------------------------------------------------
 
     def _run_guard(self) -> None:
@@ -688,8 +828,10 @@ class CheckBatcher:
             batch = self._await_work()
             if batch is None:
                 return
+            batch, _ = self._cull(batch, "dispatch")
             if not batch:
                 continue
+            FAULTS.maybe_sleep("batcher.dispatch_slow")
             with self._cv:
                 self._inflight = batch
             if self._m_batch_size is not None:
@@ -772,12 +914,14 @@ class CheckBatcher:
             items = self._await_work()
             if items is None:
                 return
+            items, _ = self._cull(items, "encode")
             if not items:
                 continue
             batch = _PBatch(items)
             holder.batch = batch
             self._register(batch)
             FAULTS.fire("batcher.encode_die")
+            FAULTS.maybe_sleep("batcher.encode_slow")
             t0 = time.perf_counter()
             self._observe("enqueue", t0 - min(it[3] for it in items))
             if self._m_batch_size is not None:
@@ -818,8 +962,20 @@ class CheckBatcher:
             batch.t_encoded = time.perf_counter()
             # ownership passes to the launch queue; bounded put is the
             # encode stage's backpressure
+            self._set_deadlines(batch.enc, batch.items)
             holder.batch = None
             self._launch_q.put(batch)
+
+    @staticmethod
+    def _set_deadlines(enc, items) -> None:
+        """Stamp per-row caller deadlines onto the encoded batch so the
+        circuit-breaker fallback can skip re-answering rows whose caller
+        already gave up. Best-effort: engines whose encoded type can't
+        carry the attribute just lose the optimization."""
+        try:
+            enc.deadlines = [it[4] for it in items]
+        except (AttributeError, TypeError):
+            pass
 
     def _launch_loop(self, holder: _Holder) -> None:
         while True:
@@ -831,6 +987,22 @@ class CheckBatcher:
             # the device stage inherits the PR-1 dispatcher fault site:
             # "the dispatcher" is now the thread that talks to the device
             FAULTS.fire("batcher.dispatcher_die")
+            FAULTS.maybe_sleep("batcher.launch_slow")
+            # cull rows that died waiting in the launch queue BEFORE the
+            # kernel dispatch: compacting the staged buffers here is the
+            # last chance to not pay device time for them
+            kept, keep_idx = self._cull(batch.items, "launch")
+            if not kept:
+                batch.enc.release()
+                self._complete(batch)
+                holder.batch = None
+                continue
+            if len(kept) < len(batch.items):
+                batch.enc.compact(keep_idx)
+                batch.items = kept
+                if batch.keys is not None:
+                    batch.keys = [batch.keys[i] for i in keep_idx]
+                self._set_deadlines(batch.enc, batch.items)
             try:
                 batch.launched = self.engine.launch_encoded(batch.enc)
             except Exception as e:
@@ -853,6 +1025,21 @@ class CheckBatcher:
                 return
             holder.batch = batch
             FAULTS.fire("batcher.decode_die")
+            FAULTS.maybe_sleep("batcher.decode_slow")
+            # rows that died on device still decode (the kernel already
+            # ran; materializing frees the staging buffers) but their
+            # callers are failed typed NOW instead of after the blocking
+            # materialization — items stay in place so results align
+            now = time.monotonic()
+            n_expired = 0
+            for item in batch.items:
+                f = item[2]
+                dl = item[4]
+                if dl is not None and now >= dl and not f.done():
+                    f.set_exception(DeadlineExceeded())
+                    n_expired += 1
+            if n_expired:
+                self._note_expired("decode", n_expired)
             t0 = time.perf_counter()
             try:
                 results = self.engine.decode_launched(batch.launched)
@@ -866,14 +1053,22 @@ class CheckBatcher:
             self._observe("device", t1 - t0)
             for item, allowed in zip(batch.items, results):
                 f = item[2]
-                if not f.done():
+                if allowed is not None and not f.done():
                     f.set_result(bool(allowed))
             if self.encoded_cache is not None and batch.keys is not None:
-                self.encoded_cache.put_many(
-                    batch.enc.version,
-                    batch.keys,
-                    [bool(v) for v in results],
-                )
+                # a None result marks a row the fallback skipped as
+                # already-dead: nothing to cache for it
+                live = [
+                    (k, bool(v))
+                    for k, v in zip(batch.keys, results)
+                    if v is not None
+                ]
+                if live:
+                    self.encoded_cache.put_many(
+                        batch.enc.version,
+                        [k for k, _ in live],
+                        [v for _, v in live],
+                    )
             self._complete(batch)
             self._observe("decode", time.perf_counter() - t1)
             holder.batch = None

@@ -87,7 +87,7 @@ class EncodedBatch:
     lazily, ONLY if that fallback actually fires."""
 
     __slots__ = (
-        "_requests", "_cols", "depths", "n", "b", "snap", "dg",
+        "_requests", "_cols", "depths", "deadlines", "n", "b", "snap", "dg",
         "start", "target", "depth",
     )
 
@@ -98,6 +98,10 @@ class EncodedBatch:
         self._requests = requests
         self._cols = cols
         self.depths = depths
+        # per-row absolute caller deadlines (monotonic secs), stamped by
+        # the batcher after encode; the breaker fallback skips re-answering
+        # rows whose entry here has passed
+        self.deadlines = None
         self.n = n
         self.b = b
         self.snap = snap
@@ -157,6 +161,8 @@ class EncodedBatch:
             self._cols = self._cols.select(keep)
         if self.depths is not None:
             self.depths = [self.depths[i] for i in keep]
+        if self.deadlines is not None:
+            self.deadlines = [self.deadlines[i] for i in keep]
         self.n = m
 
     def release(self) -> None:
@@ -500,10 +506,12 @@ class DeviceCheckEngine:
     def launch_encoded(self, enc: EncodedBatch) -> LaunchedBatch:
         """Stage 2 (the device stage): enqueue the kernel. Returns as soon
         as dispatch is accepted — the result array is still on device."""
-        # fault sites: stand-ins for an XLA compile failure and for a
-        # numerically sick chip returning garbage — the circuit breaker in
-        # engine/fallback.py is tested against exactly these
+        # fault sites: stand-ins for an XLA compile failure, a numerically
+        # sick chip returning garbage, and a slow/wedged dispatch — the
+        # circuit breaker in engine/fallback.py and the deadline culls in
+        # engine/batcher.py are tested against exactly these
         FAULTS.fire("device.compile_error")
+        FAULTS.maybe_sleep("device.slow")
         if FAULTS.should_fire("device.batch_nan"):
             return LaunchedBatch(enc, garbage=True)
         dg = enc.dg

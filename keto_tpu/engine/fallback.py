@@ -104,6 +104,7 @@ class DeviceFallbackEngine:
         self._m_failures = None
         self._m_fallback_batches = None
         self._m_open = None
+        self._m_deadline_skips = None
         if metrics is not None:
             self._m_failures = metrics.counter(
                 "keto_device_engine_failures_total",
@@ -117,6 +118,11 @@ class DeviceFallbackEngine:
             self._m_open = metrics.gauge(
                 "keto_device_circuit_open",
                 "1 while checks are served by the host fallback",
+            )
+            self._m_deadline_skips = metrics.counter(
+                "keto_fallback_deadline_skips_total",
+                "rows the host-oracle fallback did not re-answer because "
+                "their caller deadline had already passed",
             )
 
     # -- breaker bookkeeping ---------------------------------------------------
@@ -249,9 +255,10 @@ class DeviceFallbackEngine:
         # the host oracle NOW — its staging buffers go back to the pool and
         # decode becomes a no-op unwrap
         requests, depths = enc.requests, enc.depths
+        deadlines = getattr(enc, "deadlines", None)
         enc.release()
         return _FallbackAnswered(
-            self._fallback_check(requests, 0, depths)
+            self._fallback_check(requests, 0, depths, deadlines)
         )
 
     def decode_launched(self, launched) -> list[bool]:
@@ -274,6 +281,7 @@ class DeviceFallbackEngine:
             and getattr(enc, "_cols", 0) is None
         ):
             requests = enc.requests
+        deadlines = getattr(enc, "deadlines", None)
         try:
             results = self.primary.decode_launched(launched)
         except Exception as e:
@@ -282,6 +290,7 @@ class DeviceFallbackEngine:
                 requests if requests is not None else enc.requests,
                 0,
                 depths,
+                deadlines,
             )
         if not _valid_batch(results, n):
             self._record_failure(None)
@@ -289,6 +298,7 @@ class DeviceFallbackEngine:
                 requests if requests is not None else enc.requests,
                 0,
                 depths,
+                deadlines,
             )
         self._record_success()
         return [bool(v) for v in results]
@@ -323,9 +333,40 @@ class DeviceFallbackEngine:
             return [bool(v) for v in results]
         return self._fallback_check(cols.materialize(), max_depth, depths)
 
-    def _fallback_check(self, requests, max_depth, depths) -> list[bool]:
+    def _fallback_check(
+        self, requests, max_depth, depths, deadlines=None
+    ) -> list:
         if self._m_fallback_batches is not None:
             self._m_fallback_batches.inc()
+        if deadlines is not None:
+            # rows whose caller deadline already passed are not re-answered
+            # — their slot comes back as None (the batcher's decode stage
+            # failed those futures typed; a None is never cached). The
+            # comparison clock is the batcher's (time.monotonic), not the
+            # breaker's injectable one.
+            now = time.monotonic()
+            live = [
+                i
+                for i, dl in enumerate(deadlines)
+                if dl is None or now < dl
+            ]
+            if len(live) < len(requests):
+                if self._m_deadline_skips is not None:
+                    self._m_deadline_skips.inc(len(requests) - len(live))
+                sub = self._fallback_answer(
+                    [requests[i] for i in live],
+                    max_depth,
+                    None if depths is None else [depths[i] for i in live],
+                )
+                out = [None] * len(requests)
+                for i, v in zip(live, sub):
+                    out[i] = bool(v)
+                return out
+        return self._fallback_answer(requests, max_depth, depths)
+
+    def _fallback_answer(self, requests, max_depth, depths) -> list[bool]:
+        if not requests:
+            return []
         engine = self._fallback_engine()
         if depths is not None:
             # the host oracle has no per-request-depth batch entry point;

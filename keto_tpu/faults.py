@@ -47,9 +47,41 @@ site                      effect when armed
 ``client.unavailable``    test-only site for client retry paths
 ========================  ====================================================
 
-``KETO_FAULTS`` syntax: comma-separated ``site`` or ``site:count`` entries,
-e.g. ``KETO_FAULTS="delta.drop,device.batch_nan:3"`` (bare site = fire
-once). Parsed once at import; tests arm programmatically instead.
+Slowness sites (armed with :meth:`FaultRegistry.arm_slow`, consumed with
+:meth:`FaultRegistry.maybe_sleep`): the production failure mode death
+doesn't model is *latency* — a device dispatch that takes 40x p50, a
+wedged worker that never returns. Each seam below delays (``sleep=ms``)
+or blocks until disarmed (``stuck``) instead of raising:
+
+========================  ====================================================
+site                      seam that honors it when armed
+========================  ====================================================
+``batcher.dispatch_slow`` the serial dispatcher stalls before dispatching a
+                          batch (engine/batcher.py)
+``batcher.encode_slow``   a pipeline encode worker stalls before encoding
+                          its batch (engine/batcher.py)
+``batcher.launch_slow``   the pipeline launch thread stalls before the
+                          device dispatch (engine/batcher.py)
+``batcher.decode_slow``   the pipeline decode thread stalls before decoding
+                          a launched batch (engine/batcher.py)
+``device.slow``           the device engine stalls inside the dispatch
+                          itself (engine/device.py)
+``delta.slow``            the parent stalls before broadcasting a delta
+                          frame (driver/replicas.py)
+``replica.slow``          a serving replica stalls before answering a check
+                          (driver/replicas.py) — the hedging drill's seam
+========================  ====================================================
+
+``KETO_FAULTS`` syntax: comma-separated entries, each one of
+
+- ``site`` — fail-stop, fire once
+- ``site:count`` — fail-stop, fire ``count`` times
+- ``site:sleep=ms`` — slowness, delay ``ms`` milliseconds once
+- ``site:sleep=ms:count`` — slowness, delay ``count`` times
+- ``site:stuck`` — slowness, block until the site is disarmed/reset
+
+e.g. ``KETO_FAULTS="delta.drop,device.batch_nan:3,device.slow:sleep=250:2"``.
+Parsed once at import; tests arm programmatically instead.
 
 Fork semantics: the registry is plain process memory, so forked replicas
 inherit the armed state at fork time and decrement their own copies — that
@@ -64,6 +96,10 @@ from __future__ import annotations
 import os
 import threading
 from typing import Optional
+
+#: upper bound on a single ``stuck`` block: even an un-reset registry can't
+#: wedge a process (watchdogs fire long before this; CI budgets survive it)
+STUCK_CAP_S = 120.0
 
 
 class FaultInjected(RuntimeError):
@@ -82,7 +118,15 @@ class FaultRegistry:
     def __init__(self, env: Optional[dict] = None):
         self._lock = threading.Lock()
         self._armed: dict[str, int] = {}
+        # site -> [times remaining, sleep_s, stuck]; slowness is a separate
+        # map so fail-stop consumers (should_fire/fire) never race a slow
+        # arming for the same name
+        self._slow: dict[str, list] = {}
         self._fired: dict[str, int] = {}
+        # epoch event: sleepers wait on the event captured at sleep start;
+        # disarm/reset swap in a fresh one and set the old, so every
+        # in-flight sleep (and every ``stuck`` block) wakes immediately
+        self._wake = threading.Event()
         if env is not None:
             self.load_env(env)
 
@@ -94,25 +138,62 @@ class FaultRegistry:
         with self._lock:
             self._armed[site] = self._armed.get(site, 0) + times
 
+    def arm_slow(
+        self,
+        site: str,
+        sleep_ms: Optional[float] = None,
+        stuck: bool = False,
+        times: int = 1,
+    ) -> None:
+        """Arm a slowness site: each of the next ``times`` consultations of
+        :meth:`maybe_sleep` delays ``sleep_ms`` milliseconds, or — with
+        ``stuck`` — blocks until the site is disarmed/reset (capped at
+        :data:`STUCK_CAP_S`)."""
+        if times <= 0:
+            raise ValueError(f"times must be positive, got {times}")
+        if not stuck and sleep_ms is None:
+            raise ValueError("arm_slow needs sleep_ms or stuck=True")
+        sleep_s = 0.0 if sleep_ms is None else float(sleep_ms) / 1000.0
+        with self._lock:
+            self._slow[site] = [times, sleep_s, bool(stuck)]
+
     def disarm(self, site: str) -> None:
         with self._lock:
             self._armed.pop(site, None)
+            self._slow.pop(site, None)
+            wake, self._wake = self._wake, threading.Event()
+        wake.set()
 
     def reset(self) -> None:
-        """Disarm everything and zero fire counts (test teardown)."""
+        """Disarm everything and zero fire counts (test teardown); wakes
+        every in-flight sleep/stuck block."""
         with self._lock:
             self._armed.clear()
+            self._slow.clear()
             self._fired.clear()
+            wake, self._wake = self._wake, threading.Event()
+        wake.set()
 
     def load_env(self, env: Optional[dict] = None) -> None:
-        """Arm from ``KETO_FAULTS`` (``site[:count]`` comma list)."""
+        """Arm from ``KETO_FAULTS`` (see the module docstring syntax)."""
         raw = (env if env is not None else os.environ).get("KETO_FAULTS", "")
         for entry in raw.split(","):
             entry = entry.strip()
             if not entry:
                 continue
-            site, _, count = entry.partition(":")
-            self.arm(site.strip(), int(count) if count else 1)
+            parts = entry.split(":")
+            site = parts[0].strip()
+            mods = [p.strip() for p in parts[1:]]
+            if not mods:
+                self.arm(site)
+            elif mods[0] == "stuck":
+                self.arm_slow(site, stuck=True)
+            elif mods[0].startswith("sleep="):
+                ms = float(mods[0][len("sleep=") :])
+                times = int(mods[1]) if len(mods) > 1 else 1
+                self.arm_slow(site, sleep_ms=ms, times=times)
+            else:
+                self.arm(site, int(mods[0]))
 
     # -- introspection --------------------------------------------------------
 
@@ -120,21 +201,48 @@ class FaultRegistry:
         with self._lock:
             return self._armed.get(site, 0)
 
+    def slow_armed(self, site: str) -> int:
+        with self._lock:
+            spec = self._slow.get(site)
+            return spec[0] if spec else 0
+
     def fired(self, site: str) -> int:
         with self._lock:
             return self._fired.get(site, 0)
 
-    def snapshot(self) -> dict[str, int]:
+    def snapshot(self) -> dict:
         """The armed state, for shipping across a process boundary
-        (replica respawn commands carry this)."""
+        (replica respawn commands carry this). Fail-stop sites map to a
+        remaining count; slowness sites to a param dict — :meth:`load`
+        accepts both shapes."""
         with self._lock:
-            return dict(self._armed)
+            snap: dict = dict(self._armed)
+            for site, (times, sleep_s, stuck) in self._slow.items():
+                snap[site] = {
+                    "times": times,
+                    "sleep_ms": sleep_s * 1000.0,
+                    "stuck": stuck,
+                }
+            return snap
 
-    def load(self, armed: dict[str, int]) -> None:
+    def load(self, armed: dict) -> None:
         """Replace the armed state wholesale (the receiving end of
         :meth:`snapshot`)."""
         with self._lock:
-            self._armed = {k: int(v) for k, v in armed.items() if int(v) > 0}
+            self._armed = {
+                k: int(v)
+                for k, v in armed.items()
+                if not isinstance(v, dict) and int(v) > 0
+            }
+            self._slow = {
+                k: [
+                    int(v["times"]),
+                    float(v["sleep_ms"]) / 1000.0,
+                    bool(v.get("stuck", False)),
+                ]
+                for k, v in armed.items()
+                if isinstance(v, dict) and int(v["times"]) > 0
+            }
 
     # -- firing ---------------------------------------------------------------
 
@@ -156,6 +264,27 @@ class FaultRegistry:
         """Raise :class:`FaultInjected` if ``site`` is armed."""
         if self.should_fire(site):
             raise FaultInjected(site)
+
+    def maybe_sleep(self, site: str) -> float:
+        """Consume one slowness arming for ``site`` and block accordingly:
+        ``sleep_ms`` waits that long, ``stuck`` waits until disarm/reset
+        (capped at :data:`STUCK_CAP_S`). Either wait ends early when the
+        registry is disarmed/reset. Returns the seconds this call was
+        configured to stall (0.0 when unarmed) — the cost of an unarmed
+        site is one dict lookup under the lock."""
+        with self._lock:
+            spec = self._slow.get(site)
+            if spec is None:
+                return 0.0
+            spec[0] -= 1
+            if spec[0] <= 0:
+                del self._slow[site]
+            _, sleep_s, stuck = spec
+            self._fired[site] = self._fired.get(site, 0) + 1
+            wake = self._wake
+        delay = STUCK_CAP_S if stuck else sleep_s
+        wake.wait(delay)
+        return delay
 
 
 #: The process-wide registry every production fault site consults.

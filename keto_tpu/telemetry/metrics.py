@@ -248,3 +248,42 @@ def pipeline_stage_histogram(registry: MetricsRegistry) -> Histogram:
         labelnames=("stage",),
         buckets=PIPELINE_STAGE_BUCKETS,
     )
+
+
+# -- deadline / hedging telemetry --------------------------------------------
+
+# the stage label values deadline_expired_counter carries: "admission" is
+# the transport/batcher entry reject (the request never entered the queue);
+# the pipeline stages record mid-flight culls at that stage's boundary
+DEADLINE_STAGES = ("admission", "dispatch", "encode", "launch", "decode")
+
+
+def deadline_expired_counter(registry: MetricsRegistry) -> Counter:
+    """Requests dropped because their caller deadline passed, by the stage
+    that culled them — one series per DEADLINE_STAGES label value."""
+    return registry.counter(
+        "keto_deadline_expired_total",
+        "check requests dropped because the caller deadline expired, "
+        "labeled by the pipeline stage that culled them",
+        labelnames=("stage",),
+    )
+
+
+def hedge_counters(registry: MetricsRegistry) -> tuple[Counter, Counter, Counter]:
+    """(fired, won, wasted) counters for hedged single-check reads: fired =
+    a hedge was issued, won = the hedge answered first, wasted = the
+    primary answered first so the hedge's work was thrown away."""
+    return (
+        registry.counter(
+            "keto_hedge_fired_total",
+            "hedged check reads issued (at most one per request)",
+        ),
+        registry.counter(
+            "keto_hedge_won_total",
+            "hedged check reads where the hedge answered first",
+        ),
+        registry.counter(
+            "keto_hedge_wasted_total",
+            "hedged check reads where the primary answered first",
+        ),
+    )

@@ -95,6 +95,20 @@ class ErrUnavailable(KetoError):
     grpc_code = "UNAVAILABLE"
 
 
+class DeadlineExceeded(KetoError):
+    """The caller's deadline passed before (or while) the request was
+    served. Distinct from :class:`ErrUnavailable`: the server was healthy,
+    the *request* ran out of time — retrying with the same deadline is
+    pointless, so no Retry-After hint is attached."""
+
+    status_code = 504
+    status = "Gateway Timeout"
+    grpc_code = "DEADLINE_EXCEEDED"
+
+    def default_message(self) -> str:
+        return "The request deadline was exceeded."
+
+
 class ErrResourceExhausted(KetoError):
     """Load shed: the server chose to reject rather than queue without
     bound (429 / RESOURCE_EXHAUSTED). Retryable after backoff — handlers

@@ -1,0 +1,434 @@
+"""Deadline propagation drills (ISSUE 4): a caller's budget is enforced at
+every stage of the serving plane, and the enforcement is observable.
+
+- dead-on-arrival checks are rejected at admission (no engine work)
+- entries whose deadline passes while queued/staged are culled at the next
+  stage boundary (dispatch / encode / launch / decode), each cull tallied
+  per stage on pipeline_stats() and keto_deadline_expired_total
+- a client disconnect (future cancelled) frees the batch slot the same way
+- the breaker's host-oracle fallback skips re-answering expired rows
+- transports map the typed error: REST 504, gRPC DEADLINE_EXCEEDED
+"""
+
+import threading
+import time
+from concurrent.futures import CancelledError, Future
+from types import SimpleNamespace
+
+import grpc
+import pytest
+
+from keto_tpu.api import acl_pb2, check_service_pb2
+from keto_tpu.api.rest import DEADLINE_HEADER, _json_error, deadline_from_headers
+from keto_tpu.api.services import CheckServicer
+from keto_tpu.engine.batcher import CheckBatcher
+from keto_tpu.engine.fallback import DeviceFallbackEngine, _FallbackAnswered
+from keto_tpu.faults import FAULTS
+from keto_tpu.relationtuple.definitions import RelationTuple, SubjectID
+from keto_tpu.telemetry import MetricsRegistry
+from keto_tpu.utils.errors import DeadlineExceeded, ErrMalformedInput
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _tup(i: int = 0) -> RelationTuple:
+    return RelationTuple(
+        namespace="n", object=f"o{i}", relation="view",
+        subject=SubjectID(id="alice"),
+    )
+
+
+class _CountEngine:
+    def __init__(self):
+        self.calls = 0
+
+    def batch_check(self, requests, max_depth=0, depths=None):
+        self.calls += 1
+        return [True] * len(requests)
+
+    def subject_is_allowed(self, requested, max_depth=0):
+        # the host-oracle shape the breaker fallback uses for per-row depths
+        self.calls += 1
+        return True
+
+
+class _GateEngine:
+    """Blocks every batch on an event — holds the dispatcher mid-flight so
+    queue states (and what happens to entries stuck behind them) are
+    controllable."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.calls = 0
+
+    def batch_check(self, requests, max_depth=0, depths=None):
+        self.calls += 1
+        self.gate.wait(timeout=10)
+        return [True] * len(requests)
+
+
+class _FakeEncoded:
+    version = 0
+
+    def __init__(self, requests):
+        self.requests = list(requests)
+        self.released = False
+
+    def keys(self):
+        return [(r.object, 0, 0) for r in self.requests]
+
+    def compact(self, keep):
+        self.requests = [self.requests[i] for i in keep]
+
+    def release(self):
+        self.released = True
+
+
+class _SplitEngine:
+    """Split encode/launch/decode engine with deterministic True answers,
+    recording the staged batch and launch count so cull/compact behavior
+    is assertable."""
+
+    def __init__(self):
+        self.last_enc = None
+        self.launches = 0
+
+    def pipeline_supported(self):
+        return True
+
+    def encode_batch(self, requests, max_depth=0, depths=None):
+        self.last_enc = _FakeEncoded(requests)
+        return self.last_enc
+
+    def launch_encoded(self, enc):
+        self.launches += 1
+        return enc
+
+    def decode_launched(self, launched):
+        return [True] * len(launched.requests)
+
+    def batch_check(self, requests, max_depth=0, depths=None):
+        return [True] * len(requests)
+
+
+def _pipelined(engine, metrics=None):
+    return CheckBatcher(
+        engine, window_s=0, metrics=metrics,
+        pipeline_depth=2, encode_workers=1,
+    )
+
+
+def _enqueue(b, entries):
+    """Append raw (tuple, depth, Future, deadline) entries atomically so
+    they drain as ONE batch — the white-box seam for staging an entry in
+    the pipe whose caller never races the stage cull."""
+    futures = []
+    with b._cv:
+        for tup, depth, deadline in entries:
+            f = Future()
+            futures.append(f)
+            b._queue.append((tup, depth, f, time.perf_counter(), deadline))
+        b._cv.notify()
+    return futures
+
+
+class TestAdmission:
+    def test_dead_on_arrival_never_reaches_engine(self):
+        eng = _CountEngine()
+        m = MetricsRegistry()
+        b = CheckBatcher(eng, window_s=0, metrics=m)
+        try:
+            with pytest.raises(DeadlineExceeded):
+                b.check(_tup(), deadline=time.monotonic() - 0.01)
+            assert eng.calls == 0
+            assert b.pipeline_stats()["deadline_expired"] == {"admission": 1}
+            assert b._m_deadline.labels(stage="admission").value == 1
+        finally:
+            b.close()
+
+    def test_batch_path_rejects_dead_on_arrival(self):
+        eng = _CountEngine()
+        b = CheckBatcher(eng, window_s=0)
+        try:
+            with pytest.raises(DeadlineExceeded):
+                b.check_batch(
+                    [_tup(0), _tup(1)], deadline=time.monotonic() - 0.01
+                )
+            assert eng.calls == 0
+        finally:
+            b.close()
+
+    def test_live_deadline_is_served(self):
+        b = CheckBatcher(_CountEngine(), window_s=0)
+        try:
+            assert b.check(_tup(), deadline=time.monotonic() + 5) is True
+        finally:
+            b.close()
+
+
+class TestStageCulls:
+    def test_expiry_while_queued_culled_at_dispatch(self):
+        eng = _GateEngine()
+        m = MetricsRegistry()
+        b = CheckBatcher(eng, window_s=0, metrics=m)
+        try:
+            t1 = threading.Thread(target=lambda: b.check(_tup(0)), daemon=True)
+            t1.start()
+            deadline = time.time() + 5
+            while eng.calls < 1 and time.time() < deadline:
+                time.sleep(0.005)
+            assert eng.calls == 1  # dispatcher held mid-flight
+            # entry 2 waits behind it with a budget that runs out queued
+            (f,) = _enqueue(b, [(_tup(1), 0, time.monotonic() + 0.05)])
+            time.sleep(0.1)
+            eng.gate.set()
+            assert isinstance(f.exception(timeout=5), DeadlineExceeded)
+            t1.join(timeout=5)
+            assert eng.calls == 1  # the dead entry never dispatched
+            assert b.pipeline_stats()["deadline_expired"] == {"dispatch": 1}
+        finally:
+            eng.gate.set()
+            b.close()
+
+    def test_client_disconnect_culled_at_dispatch(self):
+        eng = _GateEngine()
+        b = CheckBatcher(eng, window_s=0, metrics=MetricsRegistry())
+        try:
+            t1 = threading.Thread(target=lambda: b.check(_tup(0)), daemon=True)
+            t1.start()
+            deadline = time.time() + 5
+            while eng.calls < 1 and time.time() < deadline:
+                time.sleep(0.005)
+            entries, err = [], []
+
+            def caller():
+                try:
+                    b.check(_tup(1), entry_hook=entries.append)
+                except BaseException as e:
+                    err.append(e)
+
+            t2 = threading.Thread(target=caller, daemon=True)
+            t2.start()
+            while not entries and time.time() < deadline:
+                time.sleep(0.005)
+            # the transport's disconnect hook: cancel the queued entry
+            assert entries[0].cancel() is True
+            eng.gate.set()
+            t2.join(timeout=5)
+            t1.join(timeout=5)
+            while (
+                b.pipeline_stats()["cancelled"].get("dispatch", 0) < 1
+                and time.time() < deadline
+            ):
+                time.sleep(0.005)
+            assert b.pipeline_stats()["cancelled"] == {"dispatch": 1}
+            assert eng.calls == 1  # slot freed, engine never paid
+            assert isinstance(err[0], CancelledError)
+        finally:
+            eng.gate.set()
+            b.close()
+
+    def test_deadline_mid_flight_raises_typed_and_cancels(self):
+        eng = _GateEngine()
+        b = CheckBatcher(eng, window_s=0)
+        try:
+            with pytest.raises(DeadlineExceeded):
+                b.check(_tup(), deadline=time.monotonic() + 0.1)
+            assert eng.calls == 1  # dispatched live, caller gave up waiting
+        finally:
+            eng.gate.set()
+            b.close()
+
+    def test_expired_entry_culled_at_encode(self):
+        class _GateSplit(_SplitEngine):
+            def __init__(self):
+                super().__init__()
+                self.gate = threading.Event()
+
+            def encode_batch(self, requests, max_depth=0, depths=None):
+                enc = super().encode_batch(requests, max_depth, depths)
+                self.gate.wait(timeout=10)
+                return enc
+
+        eng = _GateSplit()
+        b = _pipelined(eng, metrics=MetricsRegistry())
+        try:
+            (f1,) = _enqueue(b, [(_tup(0), 0, None)])
+            deadline = time.time() + 5
+            while eng.last_enc is None and time.time() < deadline:
+                time.sleep(0.005)
+            # encode worker held; entry 2's budget runs out in the queue
+            (f2,) = _enqueue(b, [(_tup(1), 0, time.monotonic() + 0.05)])
+            time.sleep(0.1)
+            eng.gate.set()
+            assert f1.result(timeout=10) is True
+            assert isinstance(f2.exception(timeout=10), DeadlineExceeded)
+            assert b.pipeline_stats()["deadline_expired"] == {"encode": 1}
+        finally:
+            b.close()
+
+    def test_expired_row_culled_at_launch_compacts_buffers(self):
+        eng = _SplitEngine()
+        m = MetricsRegistry()
+        b = _pipelined(eng, metrics=m)
+        try:
+            FAULTS.arm_slow("batcher.launch_slow", sleep_ms=400)
+            f_dead, f_live = _enqueue(b, [
+                (_tup(0), 0, time.monotonic() + 0.15),
+                (_tup(1), 0, None),
+            ])
+            assert f_live.result(timeout=10) is True
+            assert isinstance(f_dead.exception(timeout=10), DeadlineExceeded)
+            assert b.pipeline_stats()["deadline_expired"] == {"launch": 1}
+            assert b._m_deadline.labels(stage="launch").value == 1
+            # the staged device buffers were compacted to the live row
+            # before the kernel dispatch — the dead row never rode it
+            assert [r.object for r in eng.last_enc.requests] == ["o1"]
+            assert eng.launches == 1
+        finally:
+            b.close()
+
+    def test_fully_expired_batch_released_without_launch(self):
+        eng = _SplitEngine()
+        b = _pipelined(eng, metrics=MetricsRegistry())
+        try:
+            FAULTS.arm_slow("batcher.launch_slow", sleep_ms=300)
+            (f,) = _enqueue(b, [(_tup(0), 0, time.monotonic() + 0.1)])
+            assert isinstance(f.exception(timeout=10), DeadlineExceeded)
+            deadline = time.time() + 5
+            while not eng.last_enc.released and time.time() < deadline:
+                time.sleep(0.005)
+            assert eng.last_enc.released is True
+            assert eng.launches == 0  # no kernel time for a dead batch
+            assert b.pipeline_stats()["batches_in_pipeline"] == 0
+        finally:
+            b.close()
+
+    def test_expired_row_failed_typed_at_decode_results_stay_aligned(self):
+        eng = _SplitEngine()
+        b = _pipelined(eng, metrics=MetricsRegistry())
+        try:
+            FAULTS.arm_slow("batcher.decode_slow", sleep_ms=400)
+            f_dead, f_live = _enqueue(b, [
+                (_tup(0), 0, time.monotonic() + 0.15),
+                (_tup(1), 0, None),
+            ])
+            # the kernel already ran for both rows (decode is post-launch),
+            # but the dead caller is failed typed instead of being handed a
+            # result after the blocking materialization
+            assert f_live.result(timeout=10) is True
+            assert isinstance(f_dead.exception(timeout=10), DeadlineExceeded)
+            assert b.pipeline_stats()["deadline_expired"] == {"decode": 1}
+            assert eng.launches == 1  # too late to save device time here
+        finally:
+            b.close()
+
+
+class TestFallbackSkips:
+    def test_fallback_skips_rows_whose_deadline_passed(self):
+        m = MetricsRegistry()
+        fb = DeviceFallbackEngine(
+            _CountEngine(), lambda: _CountEngine(), metrics=m
+        )
+        out = fb._fallback_check(
+            [_tup(0), _tup(1)], 0, None,
+            deadlines=[time.monotonic() - 1, None],
+        )
+        assert out == [None, True]
+        assert fb._m_deadline_skips.value == 1
+
+    def test_launch_failure_fallback_honors_staged_deadlines(self):
+        class _Boom:
+            def launch_encoded(self, enc):
+                raise RuntimeError("sick chip")
+
+        m = MetricsRegistry()
+        fb = DeviceFallbackEngine(_Boom(), lambda: _CountEngine(), metrics=m)
+        enc = _FakeEncoded([_tup(0), _tup(1)])
+        enc.depths = [0, 0]
+        enc.deadlines = [time.monotonic() - 1, None]
+        answered = fb.launch_encoded(enc)
+        assert isinstance(answered, _FallbackAnswered)
+        assert answered.results == [None, True]
+        assert enc.released is True
+        assert fb._m_deadline_skips.value == 1
+
+
+class TestTransportMapping:
+    def test_rest_header_parsing(self):
+        assert deadline_from_headers(SimpleNamespace(headers={})) is None
+        before = time.monotonic()
+        dl = deadline_from_headers(
+            SimpleNamespace(headers={DEADLINE_HEADER: "250"})
+        )
+        assert before + 0.2 < dl < time.monotonic() + 0.3
+        with pytest.raises(ErrMalformedInput):
+            deadline_from_headers(
+                SimpleNamespace(headers={DEADLINE_HEADER: "soon"})
+            )
+        with pytest.raises(ErrMalformedInput):
+            deadline_from_headers(
+                SimpleNamespace(headers={DEADLINE_HEADER: "-5"})
+            )
+
+    def test_rest_maps_to_504(self):
+        err = DeadlineExceeded()
+        assert err.status_code == 504
+        assert err.grpc_code == "DEADLINE_EXCEEDED"
+        resp = _json_error(err)
+        assert resp.status == 504
+        # a request out of budget is not a shed request: retrying with the
+        # same deadline is pointless, so no Retry-After invitation
+        assert "Retry-After" not in resp.headers
+
+    def test_grpc_expired_rpc_aborts_deadline_exceeded(self):
+        class _Abort(Exception):
+            pass
+
+        class _Ctx:
+            def __init__(self, remaining):
+                self._remaining = remaining
+                self.callbacks = []
+                self.code = None
+
+            def time_remaining(self):
+                return self._remaining
+
+            def add_callback(self, cb):
+                self.callbacks.append(cb)
+                return True
+
+            def set_trailing_metadata(self, md):
+                pass
+
+            def abort(self, code, details):
+                self.code = code
+                raise _Abort(details)
+
+        eng = _CountEngine()
+        b = CheckBatcher(eng, window_s=0)
+        try:
+            svc = CheckServicer(b, snaptoken_fn=lambda: "7")
+            req = check_service_pb2.CheckRequest(
+                namespace="n", object="o0", relation="view",
+                subject=acl_pb2.Subject(id="alice"),
+            )
+            ctx = _Ctx(remaining=-0.25)  # client deadline already passed
+            with pytest.raises(_Abort):
+                svc.Check(req, ctx)
+            assert ctx.code is grpc.StatusCode.DEADLINE_EXCEEDED
+            assert eng.calls == 0
+            # a live RPC answers normally through the same path
+            live = _Ctx(remaining=5.0)
+            resp = svc.Check(req, live)
+            assert resp.allowed is True
+            assert resp.snaptoken == "7"
+            # the termination callback was registered for disconnect culls
+            assert live.callbacks
+        finally:
+            b.close()

@@ -179,3 +179,113 @@ class TestSpawnWorkers:
                 pool._enforce_fork_inventory()
         finally:
             stop.set()
+
+
+class TestHedgedReads:
+    """Client-side hedged single-check reads (client/hedge.py): the
+    ``replica.slow`` fault site stands in for the one briefly-slow worker
+    an SO_REUSEPORT reissue would dodge — the hedge must mask its stall,
+    fire at most once, and discard the loser's answer."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_faults(self):
+        from keto_tpu.faults import FAULTS
+
+        FAULTS.reset()
+        yield
+        FAULTS.reset()
+
+    def _counters(self):
+        from keto_tpu.telemetry import MetricsRegistry
+        from keto_tpu.telemetry.metrics import hedge_counters
+
+        return hedge_counters(MetricsRegistry())
+
+    def test_hedge_masks_slow_replica(self):
+        from keto_tpu.client import HedgePolicy, Hedger
+        from keto_tpu.faults import FAULTS
+
+        counters = self._counters()
+        # exactly one armed stall: the primary attempt eats it, the
+        # reissued duplicate sails through — CheckServicer.Check consults
+        # this same site on entry
+        FAULTS.arm_slow("replica.slow", sleep_ms=400, times=1)
+
+        def replica_check():
+            FAULTS.maybe_sleep("replica.slow")
+            return True
+
+        with Hedger(HedgePolicy(delay_s=0.05), counters=counters) as h:
+            out = h.call(replica_check)
+        assert out.result is True
+        assert out.hedged is True and out.hedge_won is True
+        assert out.elapsed_s < 0.35  # the 400ms stall never reached p99
+        fired, won, wasted = counters
+        assert (fired.value, won.value, wasted.value) == (1, 1, 0)
+
+    def test_fast_primary_never_hedges(self):
+        from keto_tpu.client import HedgePolicy, Hedger
+
+        counters = self._counters()
+        calls = []
+
+        def fast():
+            calls.append("primary")
+            return 7
+
+        with Hedger(HedgePolicy(delay_s=0.2), counters=counters) as h:
+            out = h.call(fast)
+        assert out.result == 7
+        assert out.hedged is False
+        assert calls == ["primary"]
+        assert [c.value for c in counters] == [0, 0, 0]
+
+    def test_at_most_one_hedge_and_loser_discarded(self):
+        from keto_tpu.client import HedgePolicy, Hedger
+
+        counters = self._counters()
+        started = []
+        release = threading.Event()
+
+        def primary():
+            started.append("primary")
+            release.wait(5)
+            return "stale"
+
+        def hedge():
+            started.append("hedge")
+            return "fresh"
+
+        try:
+            with Hedger(HedgePolicy(delay_s=0.02), counters=counters) as h:
+                out = h.call(primary, hedge=hedge)
+        finally:
+            release.set()
+        assert out.result == "fresh"  # the duplicate's answer was used,
+        assert started == ["primary", "hedge"]  # and issued exactly once
+        fired, won, wasted = counters
+        assert (fired.value, won.value, wasted.value) == (1, 1, 0)
+
+    def test_primary_win_after_hedge_counts_wasted(self):
+        from keto_tpu.client import HedgePolicy, Hedger
+
+        counters = self._counters()
+        release = threading.Event()
+
+        def primary():
+            time.sleep(0.08)
+            return "primary"
+
+        def hedge():
+            release.wait(5)
+            return "hedge"
+
+        try:
+            with Hedger(HedgePolicy(delay_s=0.02), counters=counters) as h:
+                out = h.call(primary, hedge=hedge)
+        finally:
+            release.set()
+        assert out.result == "primary"
+        assert out.hedged is True and out.hedge_won is False
+        fired, won, wasted = counters
+        assert (fired.value, won.value, wasted.value) == (1, 0, 1)

@@ -1,0 +1,56 @@
+"""The chaos soak as a pytest tier (slow-marked; tools/check.sh runs the
+same thing directly as its own gate). One seeded run of the in-process
+engine phase plus the forked pool phase; the harness's own invariants
+(answer parity, snaptoken monotonicity, no lost futures, bounded p99,
+pool convergence after drop/crash faults) are the assertions."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_soak(*args: str) -> dict:
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "soak.py"), *args],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=_REPO,
+    )
+    assert proc.returncode == 0, (
+        f"soak exited {proc.returncode}\nstdout:\n{proc.stdout[-4000:]}\n"
+        f"stderr:\n{proc.stderr[-4000:]}"
+    )
+    return json.loads(proc.stdout)
+
+
+def test_smoke_soak_invariants_hold():
+    doc = _run_soak("--smoke", "--seed", "4", "--pool")
+    assert doc["ok"] is True
+    engine = doc["phases"][0]
+    assert engine["violations"] == []
+    assert engine["timeouts"] == 0
+    assert engine["parity_mismatches"] == 0
+    assert len(engine["faults_injected"]) >= 3  # the schedule really ran
+    pool = doc["phases"][1]
+    assert pool["violations"] == []
+    assert pool["respawns"] >= 1  # inherited replica.crash healed
+
+
+def test_soak_schedule_is_deterministic_per_seed():
+    a = _run_soak("--smoke", "--seed", "11", "--ops", "200", "--writes",
+                  "20", "--faults", "3")
+    b = _run_soak("--smoke", "--seed", "11", "--ops", "200", "--writes",
+                  "20", "--faults", "3")
+    assert (
+        a["phases"][0]["faults_injected"]
+        == b["phases"][0]["faults_injected"]
+    )

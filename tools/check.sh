@@ -6,7 +6,11 @@
 #   2. bench smoke — bench.py --smoke end-to-end (tiny config, short
 #      server leg): the serving path must boot, answer, and emit its
 #      summary JSON with exit 0
-#   3. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
+#   3. chaos soak smoke — tools/soak.py: seeded deterministic fault
+#      schedule (crash/slow/nan + pool-phase drop/crash) under concurrent
+#      mixed load; answer parity, snaptoken monotonicity, no lost
+#      futures, bounded p99
+#   4. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
 #
 # Usage: bash tools/check.sh            (from the repo root)
 set -o pipefail
@@ -17,6 +21,9 @@ JAX_PLATFORMS=cpu python tools/verify_imports.py || exit 1
 
 echo "== bench smoke =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python bench.py --smoke || exit 1
+
+echo "== chaos soak smoke =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/soak.py --smoke --seed 4 --pool || exit 1
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

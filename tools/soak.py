@@ -1,0 +1,522 @@
+#!/usr/bin/env python
+"""Seeded chaos soak: deterministic fault schedule under concurrent load.
+
+Drives the full serving stack (registry -> breaker-wrapped device engine ->
+batcher) with a mixed read-write workload while a seeded schedule arms
+crash / slowness / garbage-output faults at fixed OPERATION COUNTS (not
+wall-clock), so the same seed always injects the same faults at the same
+points in the workload. Thread interleavings still vary run to run; every
+invariant below is interleaving-independent:
+
+- **Answer parity.** Tuples inserted before the soak and never touched
+  must always check True; tuples never inserted must always check False;
+  a tuple the writer has durably committed (insert-only set) must never
+  check False when read at-least-as-fresh (min_version pinned to its
+  commit). Transient TYPED errors (shed, crashed dispatcher, deadline)
+  are tolerated during fault windows — wrong ANSWERS never are.
+- **Snaptoken monotonicity.** The read-plane snaptoken never regresses.
+- **No lost or double-resolved futures.** Every check resolves (answer or
+  typed error) inside its per-op timeout — a lost future would surface as
+  a timeout, a double-resolution as a decode-stage crash. Both count
+  against the run. The pipeline must also drain to zero at the end.
+- **Bounded tail latency.** p99 across the run (fault windows included)
+  stays under a generous budget — a wedged stage or an unculled stuck
+  batch blows it immediately.
+
+A final parity sweep (faults cleared) compares every asserted tuple
+against a fresh host oracle over the final store.
+
+The optional pool phase (``--pool``) forks a 3-worker SO_REUSEPORT
+replica pool and mixes the distribution faults the single process cannot
+express — ``delta.drop`` (silent version gap -> resync handshake),
+``delta.slow`` (stalled propagation), ``replica.crash`` (supervisor
+respawn) — asserting every committed write converges to 200 on fresh
+connections afterward.
+
+Usage:
+    python tools/soak.py --smoke --seed 4        # the tools/check.sh tier
+    python tools/soak.py --seed 7 --ops 20000    # longer soak
+    python tools/soak.py --smoke --pool          # include the fork phase
+
+Exit 0 and a one-line summary JSON on stdout when every invariant holds;
+exit 1 with the violation list otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+from concurrent.futures import TimeoutError as _FutTimeout
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from keto_tpu.driver import Config, Registry  # noqa: E402
+from keto_tpu.faults import FAULTS  # noqa: E402
+from keto_tpu.relationtuple.definitions import (  # noqa: E402
+    RelationTuple,
+    SubjectID,
+)
+from keto_tpu.utils.errors import KetoError  # noqa: E402
+
+PER_OP_TIMEOUT_S = 10.0  # lost-future detector: no answer in this long
+P99_BUDGET_S = 3.0  # generous; catches wedged stages, not CI jitter
+
+#: the schedule draws from these (kind, site, arm kwargs). Slow sleeps are
+#: kept far below PER_OP_TIMEOUT_S so a slept batch still resolves.
+FAULT_MENU = (
+    ("crash", "batcher.dispatcher_die", {}),
+    ("crash", "device.compile_error", {"times": 2}),
+    ("nan", "device.batch_nan", {}),
+    ("slow", "device.slow", {"sleep_ms": 40, "times": 3}),
+    ("slow", "batcher.dispatch_slow", {"sleep_ms": 25, "times": 3}),
+)
+
+
+def _tup(obj: str) -> RelationTuple:
+    return RelationTuple(
+        namespace="n", object=obj, relation="view",
+        subject=SubjectID(id="alice"),
+    )
+
+
+class _Violations:
+    def __init__(self):
+        self.items: list[str] = []
+        self._lock = threading.Lock()
+
+    def add(self, msg: str) -> None:
+        with self._lock:
+            if len(self.items) < 50:  # bounded: one bad invariant can spam
+                self.items.append(msg)
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+def run_engine_soak(
+    seed: int,
+    n_ops: int = 1200,
+    n_readers: int = 4,
+    n_writes: int = 120,
+    n_faults: int = 6,
+) -> dict:
+    """The in-process phase: registry + breaker + batcher under load.
+    Returns the summary dict; violations are listed under 'violations'."""
+    rng = random.Random(seed)
+    FAULTS.reset()
+    cfg = Config(
+        values={
+            "namespaces": [{"id": 1, "name": "n"}],
+            "log": {"level": "error"},
+            "engine": {
+                "mode": "device",
+                "max_batch": 256,
+                "cache_size": 0,  # a cache hit would mask engine faults
+                "encoded_cache_size": 0,
+                "fallback_threshold": 3,
+                "fallback_cooldown_ms": 100,
+            },
+        }
+    )
+    reg = Registry(cfg)
+    store = reg.store()
+
+    static_true = [f"static{i}" for i in range(32)]
+    static_false = [f"ghost{i}" for i in range(32)]
+    store.transact_relation_tuples([_tup(o) for o in static_true], [])
+    checker = reg.checker()
+
+    # committed insert-only tuples: (object, min_version at/after commit)
+    committed: list[tuple[str, int]] = []
+    committed_lock = threading.Lock()
+    violations = _Violations()
+    ops_done = [0] * n_readers
+    latencies: list[list[tuple[float, bool]]] = [[] for _ in range(n_readers)]
+    tolerated: dict[str, int] = {}
+    tol_lock = threading.Lock()
+    timeouts = [0]
+    stop = threading.Event()
+    fault_window = threading.Event()  # any injected fault still pending
+
+    # -- deterministic schedule: (trigger at total-op count, menu entry) ----
+    schedule = sorted(
+        (rng.randrange(n_ops // 8, n_ops), rng.choice(FAULT_MENU))
+        for _ in range(n_faults)
+    )
+    injected: list[dict] = []
+
+    def injector():
+        pending = list(schedule)
+        armed_sites: list[str] = []
+        while not stop.is_set():
+            total = sum(ops_done)
+            while pending and pending[0][0] <= total:
+                trigger, (kind, site, kw) = pending.pop(0)
+                if kind == "slow":
+                    FAULTS.arm_slow(site, **kw)
+                else:
+                    FAULTS.arm(site, **kw)
+                armed_sites.append(site)
+                injected.append(
+                    {"at_op": trigger, "kind": kind, "site": site}
+                )
+                fault_window.set()
+            if fault_window.is_set() and not any(
+                FAULTS.armed(s) or FAULTS.slow_armed(s)
+                for s in armed_sites
+            ):
+                fault_window.clear()  # everything injected was consumed
+            if not pending and not fault_window.is_set():
+                return
+            stop.wait(0.002)
+
+    def writer():
+        wrote = 0
+        while wrote < n_writes and not stop.is_set():
+            obj = f"dyn{wrote}"
+            churn = f"churn{wrote % 8}"
+            before = store.version
+            # churn tuples cycle insert/delete for version traffic; their
+            # answers are never asserted. dyn tuples are insert-only, so
+            # "committed => never False" holds at any later version.
+            if wrote % 3 == 2:
+                store.transact_relation_tuples([], [_tup(churn)])
+            else:
+                store.transact_relation_tuples(
+                    [_tup(obj), _tup(churn)], []
+                )
+                with committed_lock:
+                    committed.append((obj, store.version))
+            if store.version <= before:
+                violations.add(
+                    f"store version did not advance: {before} -> "
+                    f"{store.version}"
+                )
+            wrote += 1
+            time.sleep(0.001)
+
+    def classify(e: BaseException) -> None:
+        name = type(e).__name__
+        with tol_lock:
+            tolerated[name] = tolerated.get(name, 0) + 1
+
+    def reader(idx: int):
+        r = random.Random(seed * 1000 + idx)
+        my_ops = n_ops // n_readers
+        for _ in range(my_ops):
+            if stop.is_set():
+                return
+            roll = r.random()
+            min_version = 0
+            if roll < 0.4:
+                obj, want = r.choice(static_true), True
+            elif roll < 0.7:
+                obj, want = r.choice(static_false), False
+            else:
+                with committed_lock:
+                    if committed:
+                        obj, min_version = r.choice(committed)
+                        want = True
+                    else:
+                        obj, want = r.choice(static_true), True
+            in_window = fault_window.is_set()
+            t0 = time.perf_counter()
+            try:
+                got = checker.check(
+                    _tup(obj),
+                    timeout=PER_OP_TIMEOUT_S,
+                    min_version=min_version,
+                )
+            except _FutTimeout:
+                timeouts[0] += 1  # a lost future surfaces exactly here
+            except KetoError as e:
+                classify(e)  # typed + transient: tolerated, not correct-
+                # ness — wrong answers below are the real violations
+            except Exception as e:  # noqa: BLE001
+                violations.add(f"untyped error from check: {e!r}")
+            else:
+                if got is not want:
+                    violations.add(
+                        f"wrong answer for {obj}: got {got}, want {want}"
+                        f" (min_version={min_version})"
+                    )
+            latencies[idx].append((time.perf_counter() - t0, in_window))
+            ops_done[idx] += 1
+
+    def snaptoken_monitor():
+        last = -1
+        while not stop.is_set():
+            v = int(reg.read_snaptoken())
+            if v < last:
+                violations.add(f"snaptoken regressed: {last} -> {v}")
+            last = v
+            stop.wait(0.005)
+
+    threads = [
+        threading.Thread(target=reader, args=(i,), daemon=True)
+        for i in range(n_readers)
+    ]
+    threads += [
+        threading.Thread(target=writer, daemon=True),
+        threading.Thread(target=snaptoken_monitor, daemon=True),
+    ]
+    inj = threading.Thread(target=injector, daemon=True)
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    inj.start()
+    for t in threads[:n_readers]:
+        t.join(timeout=300)
+        if t.is_alive():
+            violations.add("reader wedged past the soak budget")
+    stop.set()
+    inj.join(timeout=10)
+    for t in threads[n_readers:]:
+        t.join(timeout=10)
+    wall_s = time.perf_counter() - t_start
+
+    # -- drain + final parity sweep against the host oracle -----------------
+    FAULTS.reset()  # disarm leftovers (e.g. an unconsumed slow arming)
+    deadline = time.time() + 30
+    stats = checker.pipeline_stats()
+    while stats["batches_in_pipeline"] and time.time() < deadline:
+        time.sleep(0.05)
+        stats = checker.pipeline_stats()
+    if stats["batches_in_pipeline"]:
+        violations.add(
+            f"pipeline did not drain: {stats['batches_in_pipeline']} "
+            "batches still registered"
+        )
+    from keto_tpu.engine.check import CheckEngine
+
+    oracle = CheckEngine(store, max_depth=5)
+    with committed_lock:
+        sweep = (
+            [(o, True) for o in static_true]
+            + [(o, False) for o in static_false]
+            + [(o, True) for o, _v in committed]
+        )
+    parity_mismatches = 0
+    for obj, want in sweep:
+        o = oracle.subject_is_allowed(_tup(obj))
+        try:
+            c = checker.check(_tup(obj), timeout=PER_OP_TIMEOUT_S)
+        except KetoError:
+            c = None  # breaker may still be cooling down; oracle is truth
+        if o is not want or (c is not None and c is not o):
+            parity_mismatches += 1
+            violations.add(
+                f"parity sweep: {obj} oracle={o} served={c} want={want}"
+            )
+
+    all_lat = sorted(l for per in latencies for (l, _w) in per)
+    window_lat = sorted(l for per in latencies for (l, w) in per if w)
+    p99 = _percentile(all_lat, 0.99)
+    if p99 > P99_BUDGET_S:
+        violations.add(f"p99 {p99 * 1e3:.0f}ms over {P99_BUDGET_S}s budget")
+    if timeouts[0]:
+        violations.add(f"{timeouts[0]} checks timed out (lost futures?)")
+
+    checker.close()
+    summary = {
+        "phase": "engine",
+        "seed": seed,
+        "ops": sum(ops_done),
+        "wall_s": round(wall_s, 2),
+        "faults_injected": injected,
+        "tolerated_errors": tolerated,
+        "timeouts": timeouts[0],
+        "p50_ms": round(_percentile(all_lat, 0.50) * 1e3, 2),
+        "p99_ms": round(p99 * 1e3, 2),
+        "p99_fault_window_ms": round(
+            _percentile(window_lat, 0.99) * 1e3, 2
+        ),
+        "deadline_culls": stats.get("deadline_expired", {}),
+        "parity_mismatches": parity_mismatches,
+        "violations": violations.items,
+    }
+    return summary
+
+
+def run_pool_soak(seed: int, n_rounds: int = 3, per_round: int = 4) -> dict:
+    """The fork phase: 3-worker replica pool under delta.drop/delta.slow/
+    replica.crash; every committed write must converge to 200 on fresh
+    connections (the resync/respawn machinery is what's under test)."""
+    import asyncio
+
+    import httpx
+
+    rng = random.Random(seed + 1)
+    FAULTS.reset()
+    # armed BEFORE the fork so every replica inherits it: each child
+    # crashes applying its first delta, and the supervisor must respawn
+    # the whole pool from the zygote (the existing drill in
+    # tests/test_faults.py::test_inherited_replica_crash_fault_heals)
+    FAULTS.arm("replica.crash")
+    cfg = Config(
+        values={
+            "namespaces": [{"id": 1, "name": "n"}],
+            "log": {"level": "error"},
+            "serve": {
+                "read": {"port": 0, "host": "127.0.0.1", "workers": 3},
+                "write": {"port": 0, "host": "127.0.0.1"},
+            },
+        }
+    )
+    reg = Registry(cfg)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    violations = _Violations()
+    injected: list[dict] = []
+    t_start = time.perf_counter()
+    try:
+        rp, wp = asyncio.run_coroutine_threadsafe(
+            reg.start_all(), loop
+        ).result(timeout=120)
+        # parent disarms NOW: respawn commands carry the parent's current
+        # snapshot, so replacements come back clean and the pool heals
+        FAULTS.disarm("replica.crash")
+        injected.append({"round": -1, "site": "replica.crash"})
+
+        def put(obj: str) -> None:
+            body = {
+                "namespace": "n", "object": obj, "relation": "view",
+                "subject_id": "alice",
+            }
+            r = httpx.put(
+                f"http://127.0.0.1:{wp}/relation-tuples",
+                json=body, timeout=30,
+            )
+            if r.status_code != 201:
+                violations.add(f"write {obj} failed: {r.status_code}")
+
+        def converges(obj: str, timeout: float = 60.0) -> bool:
+            params = {
+                "namespace": "n", "object": obj, "relation": "view",
+                "subject_id": "alice",
+            }
+            deadline = time.time() + timeout
+            streak = 0
+            while streak < 12 and time.time() < deadline:
+                try:  # fresh connection per probe: covers every replica
+                    r = httpx.get(
+                        f"http://127.0.0.1:{rp}/check",
+                        params=params, timeout=10,
+                    )
+                    streak = streak + 1 if r.status_code == 200 else 0
+                except httpx.HTTPError:
+                    streak = 0
+                time.sleep(0.01)
+            return streak >= 12
+
+        wrote: list[str] = []
+        for rnd in range(n_rounds):
+            site = ("delta.drop", "delta.slow")[rng.randrange(2)]
+            if site == "delta.slow":
+                FAULTS.arm_slow(site, sleep_ms=200)
+            else:
+                FAULTS.arm(site)
+            injected.append({"round": rnd, "site": site})
+            for i in range(per_round):
+                obj = f"pool{rnd}_{i}"
+                put(obj)
+                wrote.append(obj)
+            FAULTS.reset()  # respawn snapshots must come back clean
+            for obj in wrote[-per_round:]:
+                if not converges(obj):
+                    violations.add(
+                        f"{obj} never converged after {site} round"
+                    )
+        # everything ever written still answers everywhere
+        for obj in (wrote[0], wrote[-1]):
+            if not converges(obj):
+                violations.add(f"{obj} lost after the full soak")
+        m = reg.metrics()._metrics
+        respawn_count = (
+            m["keto_replica_respawns_total"].value
+            if "keto_replica_respawns_total" in m
+            else 0
+        )
+        if respawn_count < 1:
+            violations.add(
+                "inherited replica.crash produced no respawns — the "
+                "supervisor/zygote heal path never ran"
+            )
+        summary = {
+            "phase": "pool",
+            "seed": seed,
+            "writes": len(wrote),
+            "wall_s": round(time.perf_counter() - t_start, 2),
+            "faults_injected": injected,
+            "respawns": respawn_count,
+            "resyncs": m["keto_replica_resyncs_total"].value
+            if "keto_replica_resyncs_total" in m
+            else 0,
+            "violations": violations.items,
+        }
+        return summary
+    finally:
+        FAULTS.reset()
+        try:
+            asyncio.run_coroutine_threadsafe(reg.stop_all(), loop).result(
+                timeout=30
+            )
+        except Exception:
+            pass
+        loop.call_soon_threadsafe(loop.stop)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=4)
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="small deterministic tier for tools/check.sh",
+    )
+    ap.add_argument("--ops", type=int, default=0, help="reader ops total")
+    ap.add_argument("--writes", type=int, default=0)
+    ap.add_argument("--faults", type=int, default=0)
+    ap.add_argument(
+        "--pool", action="store_true",
+        help="also run the forked replica-pool phase",
+    )
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        ops, writes, faults = 800, 80, 5
+    else:
+        ops, writes, faults = 8000, 600, 24
+    if args.ops:
+        ops = args.ops
+    if args.writes:
+        writes = args.writes
+    if args.faults:
+        faults = args.faults
+
+    phases = [run_engine_soak(args.seed, n_ops=ops, n_writes=writes,
+                              n_faults=faults)]
+    if args.pool:
+        phases.append(run_pool_soak(args.seed))
+    bad = [v for p in phases for v in p["violations"]]
+    print(json.dumps({"phases": phases, "ok": not bad}, indent=2))
+    if bad:
+        print(f"SOAK FAILED: {len(bad)} violation(s)", file=sys.stderr)
+        for v in bad:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
