@@ -33,7 +33,9 @@ BENCH_SERVER_SECONDS (default 8), BENCH_BUDGET_S (default 2400: phases
 that would start past the deadline are skipped — with a logged skip
 line — so the summary JSON always lands before any outer timeout),
 BENCH_POOL_CACHE_DIR (default <repo>/.bench-cache: generated stores are
-cached to .npz and reloaded on the next run), BENCH_PROBE_TIMEOUT_S
+cached to .npz and reloaded on the next run; a build the budget
+interrupts — e.g. the 100M pool on a slow host — persists partially and
+resumes at its recorded stage next run), BENCH_PROBE_TIMEOUT_S
 (default 30) / BENCH_PROBE_TTL_S (default 3600: backend-probe verdict
 cached to disk).
 
@@ -63,12 +65,24 @@ def _pool(items) -> np.ndarray:
     return arr
 
 
+#: last headline summary line (JSON text), re-emitted to stdout after every
+#: phase marker so the final stdout line is ALWAYS a parseable summary for
+#: the best completed config, no matter where an outer timeout lands
+_LAST_HEADLINE: str | None = None
+
+
+def _reemit_headline() -> None:
+    if _LAST_HEADLINE is not None:
+        print(_LAST_HEADLINE, flush=True)
+
+
 def _phase(msg: str) -> None:
     print(
         json.dumps({"phase": msg, "t": round(time.time(), 1)}),
         file=sys.stderr,
         flush=True,
     )
+    _reemit_headline()
 
 
 def _rss_gb() -> float:
@@ -108,7 +122,13 @@ def _skip_phase(phase_name: str, need_s: float = 0.0) -> bool:
         file=sys.stderr,
         flush=True,
     )
+    _reemit_headline()
     return True
+
+
+class _BudgetExhausted(Exception):
+    """A pool build ran out of BENCH_BUDGET_S mid-generation; the partial
+    pool has been persisted so the next run resumes instead of restarting."""
 
 
 # ---------------------------------------------------------------------------
@@ -138,7 +158,14 @@ def _pool_cache_path(tag: str, n_tuples: int) -> str:
     return os.path.join(d, f"pool_{tag}_{n_tuples}_{h}.npz")
 
 
-def _pool_cache_save(tag: str, n_tuples: int, store) -> None:
+#: stage value marking a finished pool build in the cache (see
+#: _pool_cache_save); partial saves carry the generator stage to resume at
+_STAGE_COMPLETE = 99
+
+
+def _pool_cache_save(
+    tag: str, n_tuples: int, store, stage: int = _STAGE_COMPLETE
+) -> None:
     try:
         path = _pool_cache_path(tag, n_tuples)
         os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -153,15 +180,44 @@ def _pool_cache_save(tag: str, n_tuples: int, store) -> None:
                 keys=np.frombuffer(blob, dtype=np.uint8),
                 src=store._cols["src_node"][:n],
                 dst=store._cols["dst_node"][:n],
+                stage=np.array([stage], dtype=np.int32),
             )
         os.replace(tmp, path)
-        _phase(f"pool cache saved: {path} ({os.path.getsize(path)>>20}MB)")
+        part = "" if stage >= _STAGE_COMPLETE else f" PARTIAL stage={stage}"
+        _phase(
+            f"pool cache saved{part}: {path} "
+            f"({os.path.getsize(path)>>20}MB, {n} edges)"
+        )
     except Exception as e:  # cache is an accelerant, never a failure mode
         _phase(f"pool cache save failed: {e!r}")
 
 
+def _budget_loader(tag: str, n_tuples: int, store, stage_ref: list):
+    """Chunked bulk loader that races BENCH_BUDGET_S: before each chunk it
+    checks the remaining budget, and instead of letting an outer timeout
+    kill a 100M-tuple build mid-flight it persists the partial pool
+    (resumable at ``stage_ref[0]``) and raises :class:`_BudgetExhausted`."""
+
+    def load(src_arr, dst_arr):
+        for i in range(0, len(src_arr), _CHUNK_LOAD):
+            if _budget_left() <= 15.0:
+                _pool_cache_save(tag, n_tuples, store, stage=stage_ref[0])
+                raise _BudgetExhausted(
+                    f"{tag} pool build out of budget at {len(store)}/"
+                    f"{n_tuples} live tuples; partial pool persisted"
+                )
+            store.bulk_load_edges(
+                src_arr[i : i + _CHUNK_LOAD].tolist(),
+                dst_arr[i : i + _CHUNK_LOAD].tolist(),
+            )
+
+    return load
+
+
 def _pool_cache_load(tag: str, n_tuples: int):
-    """Rebuild a ColumnarTupleStore from the cache, or None on miss."""
+    """(ColumnarTupleStore, resume_stage) from the cache, or None on miss.
+    ``resume_stage`` is ``_STAGE_COMPLETE`` for a finished pool; anything
+    lower means a budget-interrupted build the generator should resume."""
     path = _pool_cache_path(tag, n_tuples)
     if not os.path.exists(path):
         return None
@@ -186,14 +242,21 @@ def _pool_cache_load(tag: str, n_tuples: int):
         c["dst_node"][:n] = dst
         c["alive"][:n] = True
         # one sorted key chunk = what a single dedup'd bulk load leaves
-        keys64 = (src.astype(np.int64) << 32) | dst.astype(np.int64)
-        order = np.argsort(keys64)
-        store._key_chunks.append((keys64[order], order.astype(np.int64)))
+        # (skip when empty: a stage-0 partial save may hold no edges yet,
+        # and an empty chunk breaks the bulk-dedup probe)
+        if n:
+            keys64 = (src.astype(np.int64) << 32) | dst.astype(np.int64)
+            order = np.argsort(keys64)
+            store._key_chunks.append((keys64[order], order.astype(np.int64)))
         store._n = n
         store._live = n
         store._version = 1
-        _phase(f"pool cache hit: {path} ({n} edges)")
-        return store
+        stage = (
+            int(z["stage"][0]) if "stage" in z.files else _STAGE_COMPLETE
+        )
+        part = "" if stage >= _STAGE_COMPLETE else f" (partial, stage={stage})"
+        _phase(f"pool cache hit{part}: {path} ({n} edges)")
+        return store, stage
     except Exception as e:
         _phase(f"pool cache load failed (regenerating): {e!r}")
         return None
@@ -225,40 +288,47 @@ def gen_rbac(n_tuples: int, rng: np.random.Generator):
 
     # cached store: on a hit the rng skips the generation draws, so the
     # sampled workload below differs run-to-run in VALUES but not in
-    # distribution — fine for a throughput benchmark
-    store = _pool_cache_load("rbac", n_tuples)
+    # distribution — fine for a throughput benchmark. A partial hit (a
+    # previous run's budget died mid-build) resumes at the recorded stage;
+    # re-running an interrupted stage only re-draws edges of that type
+    # (dedup drops any repeats), keeping the mix close to the target.
+    cached = _pool_cache_load("rbac", n_tuples)
+    store, resume = cached if cached is not None else (None, 0)
     if store is None:
         store = ColumnarTupleStore()
+    if resume < _STAGE_COMPLETE:
+        stage = [resume]
+        load = _budget_loader("rbac", n_tuples, store, stage)
 
-        def load(src_arr, dst_arr):
-            for i in range(0, len(src_arr), _CHUNK_LOAD):
-                store.bulk_load_edges(
-                    src_arr[i : i + _CHUNK_LOAD].tolist(),
-                    dst_arr[i : i + _CHUNK_LOAD].tolist(),
-                )
-
-        # users -> groups (~40%)
-        k = int(n_tuples * 0.4)
-        _phase(f"rbac membership edges: {k}")
-        load(
-            groups[rng.integers(n_groups, size=k)],
-            users[rng.integers(n_users, size=k)],
-        )
-        # groups -> roles (~10%)
-        k = int(n_tuples * 0.1)
-        _phase(f"rbac group->role edges: {k}")
-        load(
-            roles[rng.integers(n_roles, size=k)],
-            groups[rng.integers(n_groups, size=k)],
-        )
-        # role hierarchy (~5%, naturally collision-capped at small role counts)
-        k = min(int(n_tuples * 0.05), n_roles * n_roles // 2)
-        load(
-            roles[rng.integers(n_roles, size=k)],
-            roles[rng.integers(n_roles, size=k)],
-        )
-        # resource grants -> roles or groups (rest; top up collision losses so
-        # the store really holds >= n_tuples live tuples)
+        if stage[0] <= 0:
+            # users -> groups (~40%)
+            k = int(n_tuples * 0.4)
+            _phase(f"rbac membership edges: {k}")
+            load(
+                groups[rng.integers(n_groups, size=k)],
+                users[rng.integers(n_users, size=k)],
+            )
+            stage[0] = 1
+        if stage[0] <= 1:
+            # groups -> roles (~10%)
+            k = int(n_tuples * 0.1)
+            _phase(f"rbac group->role edges: {k}")
+            load(
+                roles[rng.integers(n_roles, size=k)],
+                groups[rng.integers(n_groups, size=k)],
+            )
+            stage[0] = 2
+        if stage[0] <= 2:
+            # role hierarchy (~5%, naturally collision-capped at small
+            # role counts)
+            k = min(int(n_tuples * 0.05), n_roles * n_roles // 2)
+            load(
+                roles[rng.integers(n_roles, size=k)],
+                roles[rng.integers(n_roles, size=k)],
+            )
+            stage[0] = 3
+        # resource grants -> roles or groups (rest; top up collision losses
+        # so the store really holds >= n_tuples live tuples)
         grant_dst = _pool(list(roles) + list(groups))
         while len(store) < n_tuples:
             k = n_tuples - len(store)
@@ -294,31 +364,32 @@ def gen_github(n_tuples: int, rng: np.random.Generator):
         [("gh", f"repo{i}", p) for i in range(n_repos) for p in perms]
     )
 
-    # cached store: same rng caveat as gen_rbac — a hit changes the sampled
-    # workload's values, not its distribution
-    store = _pool_cache_load("github", n_tuples)
+    # cached store: same rng + partial-resume caveats as gen_rbac — a hit
+    # changes the sampled workload's values, not its distribution
+    cached = _pool_cache_load("github", n_tuples)
+    store, resume = cached if cached is not None else (None, 0)
     if store is None:
         store = ColumnarTupleStore()
+    if resume < _STAGE_COMPLETE:
+        stage = [resume]
+        load = _budget_loader("github", n_tuples, store, stage)
 
-        def load(src_arr, dst_arr):
-            for i in range(0, len(src_arr), _CHUNK_LOAD):
-                store.bulk_load_edges(
-                    src_arr[i : i + _CHUNK_LOAD].tolist(),
-                    dst_arr[i : i + _CHUNK_LOAD].tolist(),
-                )
-
-        # team membership (~45%)
-        k = int(n_tuples * 0.45)
-        load(
-            teams[rng.integers(n_teams, size=k)],
-            users[rng.integers(n_users, size=k)],
-        )
-        # team nesting (~3%)
-        k = int(n_tuples * 0.03)
-        load(
-            teams[rng.integers(n_teams, size=k)],
-            teams[rng.integers(n_teams, size=k)],
-        )
+        if stage[0] <= 0:
+            # team membership (~45%)
+            k = int(n_tuples * 0.45)
+            load(
+                teams[rng.integers(n_teams, size=k)],
+                users[rng.integers(n_users, size=k)],
+            )
+            stage[0] = 1
+        if stage[0] <= 1:
+            # team nesting (~3%)
+            k = int(n_tuples * 0.03)
+            load(
+                teams[rng.integers(n_teams, size=k)],
+                teams[rng.integers(n_teams, size=k)],
+            )
+            stage[0] = 2
         # repo permission grants (rest): 80% to teams, 20% direct
         # collaborators; top up collision losses
         while len(store) < n_tuples:
@@ -1620,6 +1691,17 @@ def main():
             results.append(
                 run_config(name, n, gen, batch, iters, engine_kind)
             )
+        except _BudgetExhausted as e:
+            # the partial pool is on disk; the next run resumes the build
+            print(
+                json.dumps(
+                    {"config": name, "skipped": "budget", "detail": str(e)}
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+            _reemit_headline()
+            continue
         except Exception as e:
             # one rung failing (OOM at 100M on a small host, a flaky
             # backend mid-ladder) must not zero the whole run's evidence
@@ -1717,7 +1799,9 @@ def _print_primary(results, backend_meta=None):
         ],
         **(backend_meta or {}),
     }
-    print(json.dumps(line), flush=True)
+    global _LAST_HEADLINE
+    _LAST_HEADLINE = json.dumps(line)
+    print(_LAST_HEADLINE, flush=True)
 
 
 if __name__ == "__main__":

@@ -136,6 +136,47 @@ CONFIG_SCHEMA = {
             "additionalProperties": True,
         },
         "profiling": {"type": "string"},
+        # durable write plane (store/wal.py, store/durable.py): only the
+        # non-SQL stores (memory/columnar DSNs) honor these — SQL DSNs have
+        # their own durability
+        "store": {
+            "type": "object",
+            "properties": {
+                "wal": {
+                    "type": "object",
+                    "properties": {
+                        # "" disables the WAL (volatile store, the
+                        # pre-durability behavior)
+                        "dir": {"type": "string"},
+                        # always: fsync every append before ack (zero
+                        # acked-write loss); interval: fsync at most every
+                        # sync-interval-ms (bounded loss window); off:
+                        # leave flushing to the OS (bench/import mode)
+                        "sync": {"enum": ["always", "interval", "off"]},
+                        "sync-interval-ms": {"type": "number", "minimum": 0},
+                        "segment-bytes": {"type": "integer", "minimum": 4096},
+                    },
+                    "additionalProperties": False,
+                },
+            },
+            "additionalProperties": False,
+        },
+        "checkpoint": {
+            "type": "object",
+            "properties": {
+                # "" defaults to <store.wal.dir>/checkpoints
+                "dir": {"type": "string"},
+                # cut a checkpoint when this many versions accumulated
+                # past the last one …
+                "interval-versions": {"type": "integer", "minimum": 1},
+                # … or when the last one is this old (seconds; 0 disables
+                # the age trigger)
+                "interval-s": {"type": "number", "minimum": 0},
+                # checkpoints retained on disk
+                "keep": {"type": "integer", "minimum": 1},
+            },
+            "additionalProperties": False,
+        },
         "namespaces": {
             "oneOf": [
                 {
@@ -248,6 +289,14 @@ DEFAULTS = {
     "engine.fallback_cooldown_ms": 1000,
     "engine.mesh.data": 1,
     "engine.mesh.edge": 0,
+    "store.wal.dir": "",
+    "store.wal.sync": "always",
+    "store.wal.sync-interval-ms": 50,
+    "store.wal.segment-bytes": 16 << 20,
+    "checkpoint.dir": "",
+    "checkpoint.interval-versions": 10000,
+    "checkpoint.interval-s": 300,
+    "checkpoint.keep": 2,
 }
 
 

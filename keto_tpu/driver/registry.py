@@ -116,6 +116,16 @@ class Registry:
                 "freshness rebuilds in progress)",
                 fn=self._staleness,
             )
+            if hasattr(store, "recovery"):
+                from ..telemetry.metrics import recovery_metrics
+
+                replayed, seconds, _age, gap = recovery_metrics(
+                    m, checkpoint_age_fn=store.checkpoint_age_s
+                )
+                rep = store.recovery
+                replayed.inc(rep.replayed_deltas)
+                seconds.set(rep.duration_s)
+                gap.set(1.0 if rep.gap else 0.0)
             self._metrics = m
         return self._metrics
 
@@ -175,7 +185,68 @@ class Registry:
                     "(the postgres adapter needs a psycopg driver; mysql/"
                     "cockroach would be further SQLDialect bindings)"
                 )
+            self._store = self._wrap_durable(self._store)
         return self._store
+
+    def _wrap_durable(self, store):
+        """Wrap the non-SQL stores in the durable write plane when
+        ``store.wal.dir`` is configured (store/durable.py: WAL append
+        before ack + atomic checkpoints + boot-time recovery). SQL DSNs
+        have their own durability — the knob is ignored with a warning."""
+        wal_dir = str(self.config.get("store.wal.dir") or "")
+        if not wal_dir:
+            return store
+        from ..store.durable import DurableTupleStore
+        from ..store.wal import WalError
+
+        if type(store).__name__ not in (
+            "InMemoryTupleStore",
+            "ColumnarTupleStore",
+        ):
+            self.logger().warn(
+                "store.wal.dir is set but the DSN is SQL-backed; the "
+                "database is already durable — ignoring the WAL config",
+                dsn=self.config.dsn(),
+            )
+            return store
+        try:
+            durable = DurableTupleStore(
+                store,
+                wal_dir,
+                checkpoint_dir=str(self.config.get("checkpoint.dir") or "")
+                or None,
+                sync=str(self.config.get("store.wal.sync")),
+                sync_interval_ms=float(
+                    self.config.get("store.wal.sync-interval-ms")
+                ),
+                segment_bytes=int(
+                    self.config.get("store.wal.segment-bytes")
+                ),
+                checkpoint_interval_versions=int(
+                    self.config.get("checkpoint.interval-versions")
+                ),
+                checkpoint_interval_s=float(
+                    self.config.get("checkpoint.interval-s")
+                ),
+                checkpoint_keep=int(self.config.get("checkpoint.keep")),
+            )
+        except WalError as e:
+            raise ErrMalformedInput(str(e)) from e
+        rep = durable.recovery
+        log = self.logger()
+        line = log.error if rep.gap else log.info
+        line(
+            "store recovery complete"
+            + (" WITH WAL GAP — serving possibly-stale state" if rep.gap
+               else ""),
+            checkpoint_version=rep.checkpoint_version,
+            replayed_deltas=rep.replayed_deltas,
+            final_version=rep.final_version,
+            duration_s=round(rep.duration_s, 3),
+            torn_tail_bytes=rep.torn_tail_bytes,
+            notes="; ".join(rep.notes) or "",
+        )
+        return durable
 
     def snapshots(self) -> SnapshotManager:
         if self._snapshots is None:
@@ -513,6 +584,14 @@ class Registry:
         live hit."""
         log = self.logger()
         engine = self.check_engine()
+        store = self.store()
+        if hasattr(store, "recovery"):
+            # durable write plane: seed the snapshot CSR from the
+            # checkpoint (skipping the O(E log E) warmup derive below when
+            # versions line up) and let future checkpoints embed the
+            # derived CSR
+            self._prime_recovered_csr(store)
+            store.csr_provider = self._checkpoint_csr
         # Warmup runs on a DEDICATED executor that is fully shut down
         # afterwards: the replica fork below must happen with no stray
         # threads alive (fork-after-threads is the deadlock lottery
@@ -671,6 +750,59 @@ class Registry:
         )
         return read_port, write_port
 
+    def _prime_recovered_csr(self, store) -> None:
+        """Install the CSR arrays a checkpoint carried into the freshly
+        encoded boot snapshot — valid only when the checkpoint's CSR was
+        derived at exactly this version and the padded shapes agree (the
+        padding buckets are deterministic in node/edge counts, so a match
+        means the same graph)."""
+        rep = store.recovery
+        if rep.csr is None:
+            return
+        try:
+            import numpy as np
+
+            snap = self.snapshots().snapshot()
+            indptr, indices = rep.csr
+            if (
+                rep.csr_version == snap.version
+                and snap._csr is None
+                and len(indptr) == snap.padded_nodes + 1
+                and len(indices) == snap.padded_edges
+            ):
+                snap._csr = (
+                    np.asarray(indptr, dtype=np.int32),
+                    np.asarray(indices, dtype=np.int32),
+                )
+                snap._csr_edges = snap.num_edges
+                snap._csr_extra = None
+                self.logger().info(
+                    "snapshot CSR primed from checkpoint",
+                    version=snap.version,
+                )
+        except Exception as e:
+            self.logger().warn(
+                "checkpoint CSR priming failed; warmup derives instead",
+                error=str(e),
+            )
+
+    def _checkpoint_csr(self):
+        """CSR provider for checkpoints: the current snapshot's fully
+        derived CSR, or None (never forces a derive — checkpoints must not
+        pay O(E log E) on the write path)."""
+        mgr = self._snapshots
+        if mgr is None:
+            return None
+        snap = mgr._snap
+        if (
+            snap is None
+            or snap.version != self.store().version
+            or snap._csr is None
+            or snap._csr_edges != snap.num_edges
+        ):
+            return None
+        return snap.version, snap._csr
+
     def _start_csr_primer(self) -> None:
         """Background CSR re-derivation after writes that drop the carried
         CSR (deletes, bulk loads): one primer thread at a time, always
@@ -800,6 +932,12 @@ class Registry:
             await self._write_plane.stop()
         if self._batcher is not None:
             self._batcher.close()
+        if self._store is not None and hasattr(self._store, "close_durable"):
+            # final checkpoint + WAL close: the next boot recovers from
+            # the checkpoint instead of replaying the whole log
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._store.close_durable
+            )
         if self._snapshots is not None:
             self._snapshots.close()
         if self._namespace_manager is not None and hasattr(

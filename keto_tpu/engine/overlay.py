@@ -463,15 +463,22 @@ class WriteOverlay:
         if not row_hits:
             return  # no shortest path used the edge: D is already exact
 
-        # 3. recompute the smaller projection
+        # 3. recompute the smaller projection, chunked on BOTH sides: the
+        # sweep's min-plus relaxation materializes a (chunk x edges) int16
+        # temp, so the chunk size scales inversely with the edge count to
+        # cap that temp (an unchunked (edges x cols) sweep at ~10M interior
+        # edges and a few thousand hit columns is a ~20 GB allocation)
         R = np.concatenate(row_hits)
         C = np.nonzero(col_hit)[0]
+        (src0, _, _, _), _ = self._base_groupings()
+        step = max(1, (1 << 25) // max(1, len(src0)))
         if len(C) <= len(R):
-            self._d_set_cols(C, self._sweep_cols(C))
+            for c0 in range(0, len(C), step):
+                chunk = C[c0 : c0 + step]
+                self._d_set_cols(chunk, self._sweep_cols(chunk))
         else:
-            # chunk rows to bound the (rows x edges) sweep working set
-            for c0 in range(0, len(R), 256):
-                chunk = R[c0 : c0 + 256]
+            for c0 in range(0, len(R), step):
+                chunk = R[c0 : c0 + step]
                 self._d_set_rows(chunk, self._sweep_rows(chunk))
 
     def _base_out_neighbors(self, nid: int) -> np.ndarray:

@@ -45,6 +45,19 @@ site                      effect when armed
                           the batch, as a numerically sick chip would
                           (engine/device.py)
 ``client.unavailable``    test-only site for client retry paths
+``wal.torn_write``        a WAL append writes only half its frame to disk
+                          before "the process dies" — replay must truncate
+                          the unacked torn tail (store/wal.py)
+``wal.corrupt_crc``       a WAL append lands framed but with a flipped CRC;
+                          replay must refuse the record (store/wal.py)
+``wal.crash_after_append``  a WAL append completes durably (written +
+                          fsynced) and then the process dies before acking
+                          the caller — recovery may legitimately surface
+                          the durable-but-unacked write (store/wal.py)
+``checkpoint.crash_mid_write``  the checkpoint writer dies with a
+                          half-written tmp file before the atomic rename;
+                          readers must keep seeing the previous checkpoint
+                          (graph/checkpoint.py)
 ========================  ====================================================
 
 Slowness sites (armed with :meth:`FaultRegistry.arm_slow`, consumed with

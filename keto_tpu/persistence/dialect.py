@@ -9,8 +9,8 @@ a `SQLDialect`:
 
 - placeholder spelling      (`?` vs `%s`)
 - conflict-ignoring insert  (INSERT OR IGNORE vs ON CONFLICT DO NOTHING)
-- version upsert-returning  (both sqlite and postgres speak
-  ON CONFLICT ... RETURNING; other engines can override bump_version whole)
+- version bump              (portable upsert + read-back; engines with a
+  different upsert spelling override bump_version whole)
 - connection setup          (PRAGMAs vs server settings)
 - per-dialect migration overlays (<ver>_<name>.<dialect>.up.sql preferred
   over the generic <ver>_<name>.up.sql, like the reference's per-dialect
@@ -54,20 +54,25 @@ class SQLDialect:
             "ON CONFLICT DO NOTHING"
         )
 
-    def bump_version_sql(self) -> str:
-        """Atomic version := version + 1 upsert returning the new value;
-        one parameter (nid)."""
-        return (
-            "INSERT INTO keto_store_version (nid, version) VALUES (?, 1) "
-            "ON CONFLICT(nid) DO UPDATE SET version = "
-            "keto_store_version.version + 1 RETURNING version"
-        )
-
     def bump_version(self, exec_fn, nid: str) -> int:
         """Run the version bump through the store's executor and return
-        the new value. Engines without upsert-RETURNING (mysql) override
-        this whole hook instead of the SQL string."""
-        return int(exec_fn(self.bump_version_sql(), (nid,)).fetchone()[0])
+        the new value: ON CONFLICT upsert, then read back in the same
+        transaction. Deliberately not ``RETURNING`` — sqlite only grew it
+        in 3.35 and deployed runtimes still ship older libraries; the
+        read-back sees this transaction's own increment, and the row lock
+        the upsert takes serializes concurrent bumpers, so the two forms
+        are equivalent. Engines with a different upsert spelling (mysql)
+        override this whole hook."""
+        exec_fn(
+            "INSERT INTO keto_store_version (nid, version) VALUES (?, 1) "
+            "ON CONFLICT(nid) DO UPDATE SET version = "
+            "keto_store_version.version + 1",
+            (nid,),
+        )
+        row = exec_fn(
+            "SELECT version FROM keto_store_version WHERE nid = ?", (nid,)
+        ).fetchone()
+        return int(row[0])
 
     def migration_files(self, directory: str) -> dict[str, str]:
         """filename -> path, with <ver>_<name>.<dialect>.{up,down}.sql
@@ -153,11 +158,10 @@ class CockroachDialect(PostgresDialect):
 
 
 class MySQLDialect(SQLDialect):
-    """MySQL adapter: %s placeholders, INSERT IGNORE, a two-statement
-    version bump (MySQL has no RETURNING; ON DUPLICATE KEY UPDATE + read
-    back under the store's write lock is equivalent), and the *.mysql.*
-    migration overlays (reference persister.go:50-51 serves mysql through
-    pop the same way).
+    """MySQL adapter: %s placeholders, INSERT IGNORE, the ON DUPLICATE
+    KEY UPDATE spelling of the two-statement version bump, and the
+    *.mysql.* migration overlays (reference persister.go:50-51 serves
+    mysql through pop the same way).
 
     Driver resolution: pymysql, MySQLdb; without either, the in-tree
     DB-API translation shim (`mysqlfake.py`) serves DSNs flagged
@@ -174,9 +178,6 @@ class MySQLDialect(SQLDialect):
             f"INSERT IGNORE INTO {table} "
             f"({', '.join(cols)}) VALUES ({ph})"
         )
-
-    def bump_version_sql(self) -> str:  # pragma: no cover - guarded below
-        raise NotImplementedError("mysql uses bump_version()")
 
     def bump_version(self, exec_fn, nid: str) -> int:
         exec_fn(

@@ -20,10 +20,19 @@ CREATE TABLE keto_relation_tuples (
        AND (subject_set_object IS NULL) = (subject_set_relation IS NULL))
 );
 
+-- Dedup index. The subject columns are nullable (exactly one side of the
+-- subject union is set per row), and MySQL unique indexes treat NULL as
+-- distinct from NULL -- a raw-column index here never rejects a duplicate
+-- tuple, because every row carries NULLs on one side. Wrap each nullable
+-- column in a functional key part (MySQL 8.0.13+; note the doubled parens)
+-- that coalesces NULL to '' so two identical tuples collide. '' never
+-- aliases a real value: validation rejects empty subject fields.
 CREATE UNIQUE INDEX keto_relation_tuples_uq
     ON keto_relation_tuples (nid, namespace, object, relation,
-        subject_id, subject_set_namespace,
-        subject_set_object, subject_set_relation);
+        (coalesce(subject_id, '')),
+        (coalesce(subject_set_namespace, '')),
+        (coalesce(subject_set_object, '')),
+        (coalesce(subject_set_relation, '')));
 
 CREATE INDEX keto_relation_tuples_subject_id_idx
     ON keto_relation_tuples (nid, namespace, object, relation, subject_id);

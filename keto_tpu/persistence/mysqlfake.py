@@ -14,6 +14,12 @@ uses pymysql/MySQLdb and this module is never imported.
 
 DSN form: ``mysql+fake://<anything>/<database>`` — each database name maps
 to its own sqlite file in a process-wide temp dir.
+
+Semantics note: MySQL 8.0.13 functional index key parts — the doubled-paren
+``(coalesce(col, ''))`` form the unique dedup index uses — pass through
+untranslated and land as sqlite expression indexes, which enforce the same
+NULL-coalescing uniqueness, so the duplicate-write contract tests exercise
+the real index semantics here too.
 """
 
 from __future__ import annotations

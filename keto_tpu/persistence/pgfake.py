@@ -153,8 +153,26 @@ class _Session(socketserver.BaseRequestHandler):
             sql = body.rstrip(b"\x00").decode()
             self._run_query(sql)
 
+    _SET_RE = re.compile(
+        r"^SET\s+(?:SESSION\s+|LOCAL\s+)?(\w+)\s*(?:=|\s+TO\s+)\s*(.+?)\s*;?\s*$",
+        re.I | re.S,
+    )
+
     def _run_query(self, sql: str) -> None:
         db = self._db
+        # session SETs (the client pins standard_conforming_strings at
+        # connect) never reach sqlite: acknowledge like postgres does —
+        # ParameterStatus, then CommandComplete 'SET'
+        m = self._SET_RE.match(sql.strip())
+        if m:
+            name = m.group(1).lower()
+            value = m.group(2).strip().strip("'\"")
+            self._send(
+                b"S", name.encode() + b"\x00" + value.encode() + b"\x00"
+            )
+            self._send(b"C", b"SET\x00")
+            self._send(b"Z", b"T" if db.in_transaction else b"I")
+            return
         try:
             cur = db.execute(_translate(sql))
             rows = cur.fetchall() if cur.description else []

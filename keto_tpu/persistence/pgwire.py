@@ -27,6 +27,7 @@ persister (internal/persistence/sql/persister.go:50-51).
 
 from __future__ import annotations
 
+import math
 import socket
 import struct
 from typing import Optional
@@ -65,6 +66,11 @@ def quote_literal(value) -> str:
         return "NULL"
     if isinstance(value, bool):
         return "TRUE" if value else "FALSE"
+    if isinstance(value, float) and not math.isfinite(value):
+        # repr(inf/nan) would interpolate as a bare SQL identifier, not a
+        # number — reject instead of shipping malformed (and injectable)
+        # SQL to the server
+        raise Error(f"non-finite float parameter: {value!r}")
     if isinstance(value, (int, float)):
         return repr(value)
     if isinstance(value, (bytes, bytearray)):
@@ -171,7 +177,20 @@ class Connection:
         self._sock.settimeout(60.0)
         self._in_txn = False
         self._closed = False
+        #: server-reported ParameterStatus values (server_version, ...)
+        self.parameters: dict[str, str] = {}
         self._startup(user, database, password)
+        # quote_literal escapes quotes by doubling only — that spelling is
+        # safe iff the server treats backslashes in '...' literally. Pin
+        # the setting instead of trusting the server default; a server
+        # that refuses it cannot be spoken to safely.
+        try:
+            self._simple_query("SET standard_conforming_strings = on")
+        except Error as e:
+            self.close()
+            raise OperationalError(
+                f"server refused SET standard_conforming_strings = on: {e}"
+            ) from e
 
     # -- protocol --------------------------------------------------------------
 
@@ -205,13 +224,23 @@ class Connection:
                 raise OperationalError(
                     f"unsupported auth method {code} (trust/cleartext only)"
                 )
-            if kind in (b"S", b"K", b"N"):  # params / key data / notice
+            if kind == b"S":
+                self._parameter_status(body)
+                continue
+            if kind in (b"K", b"N"):  # key data / notice
                 continue
             if kind == b"Z":
                 return
             if kind == b"E":
                 raise OperationalError(_error_text(body))
             raise OperationalError(f"unexpected startup message {kind!r}")
+
+    def _parameter_status(self, body: bytes) -> None:
+        try:
+            name, value = body.rstrip(b"\x00").split(b"\x00", 1)
+        except ValueError:
+            return
+        self.parameters[name.decode()] = value.decode()
 
     def _simple_query(self, sql: str):
         self._send(b"Q", sql.encode() + b"\x00")
@@ -230,7 +259,9 @@ class Connection:
                 rowcount = _rowcount_from_tag(body)
             elif kind == b"E":
                 error = _error_text(body)
-            elif kind in (b"S", b"N", b"I"):  # status/notice/empty query
+            elif kind == b"S":  # ParameterStatus (e.g. after SET)
+                self._parameter_status(body)
+            elif kind in (b"N", b"I"):  # notice / empty query
                 continue
             elif kind == b"Z":
                 status = body[:1]

@@ -1,4 +1,14 @@
 from .memory import InMemoryTupleStore
 from .columnar import ColumnarTupleStore
+from .durable import DurableTupleStore, RecoveryReport, recover_store
+from .wal import WriteAheadLog, WalError
 
-__all__ = ["InMemoryTupleStore", "ColumnarTupleStore"]
+__all__ = [
+    "InMemoryTupleStore",
+    "ColumnarTupleStore",
+    "DurableTupleStore",
+    "RecoveryReport",
+    "recover_store",
+    "WriteAheadLog",
+    "WalError",
+]

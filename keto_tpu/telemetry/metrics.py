@@ -269,6 +269,41 @@ def deadline_expired_counter(registry: MetricsRegistry) -> Counter:
     )
 
 
+# -- durability / recovery telemetry ------------------------------------------
+
+
+def recovery_metrics(
+    registry: MetricsRegistry, checkpoint_age_fn=None
+) -> tuple[Counter, Gauge, Gauge, Gauge]:
+    """(replayed, seconds, checkpoint_age, gap) for the durable write
+    plane (store/durable.py): replayed = WAL deltas applied at the last
+    boot, seconds = how long that recovery took, checkpoint_age = seconds
+    since the newest checkpoint (sampled at scrape via
+    ``checkpoint_age_fn``), gap = 1 when recovery found a WAL
+    discontinuity and the store is serving possibly-stale state."""
+    return (
+        registry.counter(
+            "keto_recovery_replayed_deltas_total",
+            "WAL delta records replayed during boot-time store recovery",
+        ),
+        registry.gauge(
+            "keto_recovery_seconds",
+            "wall time of the last boot-time store recovery "
+            "(checkpoint load + WAL replay)",
+        ),
+        registry.gauge(
+            "keto_checkpoint_age_seconds",
+            "seconds since the newest store checkpoint was cut",
+            fn=checkpoint_age_fn,
+        ),
+        registry.gauge(
+            "keto_recovery_gap",
+            "1 when boot-time recovery found a WAL gap (acked writes may "
+            "be missing; serving stale)",
+        ),
+    )
+
+
 def hedge_counters(registry: MetricsRegistry) -> tuple[Counter, Counter, Counter]:
     """(fired, won, wasted) counters for hedged single-check reads: fired =
     a hedge was issued, won = the hedge answered first, wasted = the

@@ -47,6 +47,29 @@ class TestWrite:
                 RelationTuple("unknown namespace", "", "", SubjectID(""))
             )
 
+    def test_duplicate_write_is_idempotent(self, store, ns):
+        """Writing the same tuple twice must leave exactly one row, and the
+        re-insert must report not-fresh (an empty inserted delta). The
+        SubjectSet case is the one MySQL historically got wrong: unique
+        indexes over raw nullable subject columns never collide because
+        NULL != NULL there — the dedup index coalesces them instead."""
+        nspace = ns("dup-ns")
+        deltas = []
+        store.subscribe_deltas(
+            lambda v, ins, dels: deltas.append(len(ins or []))
+        )
+        for t in [
+            RelationTuple(nspace, "obj", "rel", SubjectID("sub")),
+            RelationTuple(
+                nspace, "obj", "rel", SubjectSet(nspace, "grp", "member")
+            ),
+        ]:
+            store.write_relation_tuples(t)
+            store.write_relation_tuples(t)
+            resp, _ = store.get_relation_tuples(t.to_query())
+            assert resp == [t]
+        assert deltas == [1, 0, 1, 0]
+
 
 class TestGet:
     def test_query_combinations(self, store, ns):

@@ -9,7 +9,9 @@
 #   3. chaos soak smoke — tools/soak.py: seeded deterministic fault
 #      schedule (crash/slow/nan + pool-phase drop/crash) under concurrent
 #      mixed load; answer parity, snaptoken monotonicity, no lost
-#      futures, bounded p99
+#      futures, bounded p99; plus the kill-and-restart drill (SIGKILL at
+#      every WAL/checkpoint fault site, post-recovery parity vs a shadow
+#      oracle)
 #   4. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
 #
 # Usage: bash tools/check.sh            (from the repo root)
@@ -23,7 +25,7 @@ echo "== bench smoke =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python bench.py --smoke || exit 1
 
 echo "== chaos soak smoke =="
-timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/soak.py --smoke --seed 4 --pool || exit 1
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/soak.py --smoke --seed 4 --pool --restart || exit 1
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -58,6 +58,113 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+
+def _restart_child_main(spec_raw: str) -> int:
+    """Inner process of the kill-and-restart drill (--restart): recover
+    the durable store, run a deterministic single-writer op stream
+    printing an INTENT line before each mutator and an ACK line after it
+    returns, and — when the spec arms a crash-fault site — SIGKILL
+    ourselves the moment it fires. The parent (run_restart_drill) replays
+    the ack protocol against its own read-only recovery of the directory.
+
+    Imports stay store-local on purpose: a dozen child processes that each
+    paid the jax import tax would blow the soak's CI budget.
+    """
+    import signal
+
+    spec = json.loads(spec_raw)
+    from keto_tpu.faults import FAULTS, FaultInjected
+    from keto_tpu.relationtuple.definitions import (
+        RelationTuple,
+        SubjectID,
+    )
+    from keto_tpu.store import (
+        ColumnarTupleStore,
+        DurableTupleStore,
+        InMemoryTupleStore,
+    )
+    from keto_tpu.store.wal import encode_tuple
+
+    def emit(obj) -> None:
+        print(json.dumps(obj), flush=True)
+
+    FAULTS.reset()
+    inner = (
+        InMemoryTupleStore()
+        if spec["kind"] == "memory"
+        else ColumnarTupleStore()
+    )
+    store = DurableTupleStore(
+        inner,
+        spec["dir"],
+        sync="always",
+        # the drill drives checkpoints explicitly; background triggers
+        # would make the replay accounting nondeterministic
+        checkpoint_interval_versions=10**9,
+        checkpoint_interval_s=0.0,
+    )
+    rep = store.recovery
+    emit(
+        {
+            "recovered": True,
+            "version": rep.final_version,
+            "replayed": rep.replayed_deltas,
+            "gap": rep.gap,
+            "checkpoint_version": rep.checkpoint_version,
+        }
+    )
+    site = spec.get("site")
+    fault_at = spec.get("fault_at")
+    ops = int(spec["ops"])
+    rng = random.Random(int(spec["seed"]) * 7919 + int(spec["cycle"]))
+    candidates = list(inner.all_tuples())
+    try:
+        for i in range(ops):
+            if i == fault_at and site == "checkpoint.crash_mid_write":
+                FAULTS.arm(site)
+                emit({"ckpt_at": i})
+                store.checkpoint_now()  # raises FaultInjected
+            if i == fault_at and site in (
+                "wal.torn_write", "wal.corrupt_crc", "wal.crash_after_append"
+            ):
+                FAULTS.arm(site)
+            if candidates and rng.random() < 0.18:
+                t = candidates[rng.randrange(len(candidates))]
+                emit({"op": i, "k": "d", "t": encode_tuple(t)})
+                store.delete_relation_tuples(t)
+                candidates.remove(t)
+            else:
+                t = RelationTuple(
+                    namespace="n",
+                    object=f"o{rng.randrange(max(8, ops * 3))}",
+                    relation="view",
+                    subject=SubjectID(id=f"u{rng.randrange(7)}"),
+                )
+                emit({"op": i, "k": "w", "t": encode_tuple(t)})
+                store.write_relation_tuples(t)
+                if t not in candidates:
+                    candidates.append(t)
+            emit({"ack": i, "version": store.version})
+            if site is None and i == ops // 2:
+                store.checkpoint_now()
+                emit({"ckpt": i, "version": store.version})
+    except FaultInjected as e:
+        # a real crash, not an orderly unwind: nothing may flush or close
+        emit({"crashed": True, "site": e.site})
+        os.kill(os.getpid(), signal.SIGKILL)
+    if site is None:
+        store.close_durable()  # exercises the shutdown checkpoint
+    emit({"done": True, "version": store.version})
+    return 0
+
+
+if "--restart-child" in sys.argv:
+    # handled BEFORE the keto_tpu.driver import below: the child only
+    # needs the store layer, not the engine stack
+    sys.exit(
+        _restart_child_main(sys.argv[sys.argv.index("--restart-child") + 1])
+    )
+
 from keto_tpu.driver import Config, Registry  # noqa: E402
 from keto_tpu.faults import FAULTS  # noqa: E402
 from keto_tpu.relationtuple.definitions import (  # noqa: E402
@@ -477,6 +584,230 @@ def run_pool_soak(seed: int, n_rounds: int = 3, per_round: int = 4) -> dict:
         loop.call_soon_threadsafe(loop.stop)
 
 
+def _tree_sig(tree):
+    """Order-independent canonical form of an expand tree for parity
+    comparison (children arrive in store insertion order, which differs
+    between a recovered store and a freshly rebuilt oracle)."""
+    if tree is None:
+        return None
+    d = tree.to_dict()
+
+    def canon(node):
+        kids = node.get("children")
+        if kids:
+            node["children"] = sorted(
+                (canon(k) for k in kids), key=lambda n: json.dumps(n, sort_keys=True)
+            )
+        return node
+
+    return json.dumps(canon(d), sort_keys=True)
+
+
+def run_restart_drill(seed: int, ops_per_cycle: int = 40) -> dict:
+    """Kill-and-restart drill: SIGKILL the writer at every seeded crash
+    fault under ``wal.sync=always`` and assert zero acked-write loss.
+
+    For each store kind (memory, columnar) the drill runs a child process
+    per cycle (clean warm-up with mid-run + shutdown checkpoints, then one
+    cycle per crash site, then a clean verify). The child prints an INTENT
+    line before each mutator and an ACK line after it returns; a fired
+    fault SIGKILLs the child mid-protocol. After every child exits, the
+    parent recovers the directory READ-ONLY and asserts:
+
+    - no WAL gap, and a checkpoint is in play after the first cycle
+      (replay alone must not carry the whole history);
+    - the recovered tuple set is exactly the acked oracle — plus, only
+      for ``wal.crash_after_append``, the one durable-but-unacked op
+      (written + fsynced before the kill: recovering it is correct);
+    - the recovered snaptoken matches the same rule and never regresses;
+    - Check AND Expand parity between the recovered store and a fresh
+      in-memory shadow oracle holding the same tuples.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    from keto_tpu.engine.check import CheckEngine
+    from keto_tpu.engine.expand import ExpandEngine
+    from keto_tpu.relationtuple.definitions import SubjectSet
+    from keto_tpu.store import (
+        ColumnarTupleStore,
+        InMemoryTupleStore,
+        recover_store,
+    )
+    from keto_tpu.store.wal import decode_tuple, encode_tuple
+
+    t0 = time.monotonic()
+    viol = _Violations()
+    cycles_run = 0
+    crash_sites = (
+        "wal.crash_after_append",
+        "wal.torn_write",
+        "wal.corrupt_crc",
+        "checkpoint.crash_mid_write",
+    )
+    for kind, store_cls in (
+        ("memory", InMemoryTupleStore),
+        ("columnar", ColumnarTupleStore),
+    ):
+        root = tempfile.mkdtemp(prefix=f"keto-restart-{kind}-")
+        wal_dir = os.path.join(root, "wal")
+        ckpt_dir = os.path.join(wal_dir, "checkpoints")
+        oracle: set = set()  # acked tuple state (encoded, hashable)
+        last_ack_version = 0
+        prev_recovered_version = 0
+        try:
+            schedule = [None] + list(crash_sites) + [None]
+            for cycle, site in enumerate(schedule):
+                tag = f"{kind}/cycle{cycle}/{site or 'clean'}"
+                spec = {
+                    "dir": wal_dir,
+                    "kind": kind,
+                    "site": site,
+                    # past the mid-cycle point so crashes land on a
+                    # non-empty uncheckpointed suffix
+                    "fault_at": (ops_per_cycle * 2) // 3 if site else None,
+                    "ops": ops_per_cycle,
+                    "seed": seed,
+                    "cycle": cycle,
+                }
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--restart-child", json.dumps(spec)],
+                    capture_output=True, text=True, timeout=180,
+                )
+                lines = []
+                for raw in proc.stdout.splitlines():
+                    try:
+                        lines.append(json.loads(raw))
+                    except json.JSONDecodeError:
+                        viol.add(f"{tag}: undecodable child line {raw!r}")
+                crashed = any("crashed" in l for l in lines)
+                done = any("done" in l for l in lines)
+                if site and not crashed:
+                    viol.add(f"{tag}: armed fault never fired "
+                             f"(rc={proc.returncode})")
+                    continue
+                if not site and not done:
+                    viol.add(
+                        f"{tag}: clean cycle did not complete "
+                        f"(rc={proc.returncode}, stderr tail: "
+                        f"{proc.stderr[-400:]!r})"
+                    )
+                    continue
+                cycles_run += 1
+
+                # -- child's own recovery report for this cycle ---------------
+                rec = next((l for l in lines if l.get("recovered")), None)
+                if rec is None:
+                    viol.add(f"{tag}: child printed no recovery line")
+                    continue
+                if rec["gap"]:
+                    viol.add(f"{tag}: child recovery reported a WAL gap")
+                if cycle >= 1 and rec["checkpoint_version"] == 0:
+                    viol.add(f"{tag}: recovery ran without a checkpoint "
+                             "(full-history replay)")
+                if rec["version"] < prev_recovered_version:
+                    viol.add(
+                        f"{tag}: snaptoken regressed across restart: "
+                        f"{prev_recovered_version} -> {rec['version']}"
+                    )
+
+                # -- fold this cycle's acked ops into the oracle --------------
+                acked = {l["ack"] for l in lines if "ack" in l}
+                intents = [l for l in lines if "op" in l]
+                uncertain = None
+                for intent in intents:
+                    key = tuple(intent["t"])
+                    if intent["op"] in acked:
+                        if intent["k"] == "w":
+                            oracle.add(key)
+                        else:
+                            oracle.discard(key)
+                    elif uncertain is None:
+                        uncertain = intent
+                    else:
+                        viol.add(f"{tag}: more than one unacked intent")
+                versions = [l["version"] for l in lines if "ack" in l]
+                if versions and versions != sorted(versions):
+                    viol.add(f"{tag}: ack versions not monotonic")
+                if versions:
+                    last_ack_version = versions[-1]
+
+                # -- parent-side read-only recovery + invariants --------------
+                recovered = store_cls()
+                rep = recover_store(recovered, wal_dir, ckpt_dir)
+                if rep.gap:
+                    viol.add(f"{tag}: parent recovery reported a WAL gap: "
+                             f"{rep.notes}")
+                got = {tuple(encode_tuple(t)) for t in recovered.all_tuples()}
+                expect = set(oracle)
+                expect_version = last_ack_version
+                if site == "wal.crash_after_append" and uncertain is not None:
+                    # the killed op's record was durable (written + fsynced)
+                    # before the kill: recovery MUST surface it
+                    key = tuple(uncertain["t"])
+                    if uncertain["k"] == "w":
+                        expect.add(key)
+                    else:
+                        expect.discard(key)
+                    expect_version = last_ack_version + 1
+                if got != expect:
+                    viol.add(
+                        f"{tag}: acked-write divergence: "
+                        f"{len(expect - got)} lost, "
+                        f"{len(got - expect)} phantom"
+                    )
+                else:
+                    # adopt: the durable-but-unacked op (if any) is now
+                    # part of the baseline the next cycle builds on
+                    oracle = expect
+                if rep.final_version != expect_version:
+                    viol.add(
+                        f"{tag}: recovered snaptoken {rep.final_version} "
+                        f"!= expected {expect_version}"
+                    )
+                last_ack_version = max(last_ack_version, rep.final_version)
+                prev_recovered_version = rep.final_version
+
+                # -- Check/Expand parity vs a fresh shadow oracle -------------
+                tuples = recovered.all_tuples()
+                shadow = InMemoryTupleStore()
+                if tuples:
+                    shadow.write_relation_tuples(*tuples)
+                ce_r = CheckEngine(recovered)
+                ce_s = CheckEngine(shadow)
+                for t in tuples[:25]:
+                    if not ce_r.subject_is_allowed(t):
+                        viol.add(f"{tag}: recovered store denies {t}")
+                    if not ce_s.subject_is_allowed(t):
+                        viol.add(f"{tag}: shadow oracle denies {t}")
+                for j in range(8):
+                    probe = decode_tuple(
+                        ["n", f"absent{j}", "view", 0, "nobody"]
+                    )
+                    if ce_r.subject_is_allowed(probe) or ce_s.subject_is_allowed(
+                        probe
+                    ):
+                        viol.add(f"{tag}: phantom allow for {probe}")
+                ee_r = ExpandEngine(recovered)
+                ee_s = ExpandEngine(shadow)
+                for obj in sorted({t.object for t in tuples})[:5]:
+                    ss = SubjectSet(namespace="n", object=obj, relation="view")
+                    if _tree_sig(ee_r.build_tree(ss)) != _tree_sig(
+                        ee_s.build_tree(ss)
+                    ):
+                        viol.add(f"{tag}: expand divergence on {obj}")
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    return {
+        "phase": "restart",
+        "cycles": cycles_run,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+        "violations": viol.items,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=4)
@@ -490,6 +821,10 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--pool", action="store_true",
         help="also run the forked replica-pool phase",
+    )
+    ap.add_argument(
+        "--restart", action="store_true",
+        help="also run the durable-store kill-and-restart drill",
     )
     args = ap.parse_args(argv)
 
@@ -508,6 +843,12 @@ def main(argv=None) -> int:
                               n_faults=faults)]
     if args.pool:
         phases.append(run_pool_soak(args.seed))
+    if args.restart:
+        phases.append(
+            run_restart_drill(
+                args.seed, ops_per_cycle=40 if args.smoke else 120
+            )
+        )
     bad = [v for p in phases for v in p["violations"]]
     print(json.dumps({"phases": phases, "ok": not bad}, indent=2))
     if bad:
