@@ -76,12 +76,46 @@ def _reemit_headline() -> None:
         print(_LAST_HEADLINE, flush=True)
 
 
+#: the previous _phase marker — the heartbeat's "last completed step", so
+#: a timeout post-mortem shows both what was live and what had finished
+_LAST_PHASE: str | None = None
+
+
+def _heartbeat_path() -> str:
+    return os.environ.get(
+        "BENCH_HEARTBEAT_FILE", "BENCH_run.heartbeat.jsonl"
+    )
+
+
+def _heartbeat(phase: str, **extra) -> None:
+    """Append a progress line to the heartbeat JSONL. An rc-124 timeout
+    kills stdout mid-phase; this file survives and names the phase that
+    hung, how far the run had got, and how much wall it had spent
+    (BENCH_r05 left no such record)."""
+    global _LAST_PHASE
+    line = {
+        "phase": phase,
+        "wall_s": round(time.monotonic() - _T_START, 1),
+        "last_completed": _LAST_PHASE,
+        "t_mono": round(time.monotonic(), 3),
+        "t": round(time.time(), 1),
+        **extra,
+    }
+    _LAST_PHASE = phase
+    try:
+        with open(_heartbeat_path(), "a") as f:
+            f.write(json.dumps(line) + "\n")
+    except OSError:
+        pass  # heartbeat is evidence, never a reason to fail the run
+
+
 def _phase(msg: str) -> None:
     print(
         json.dumps({"phase": msg, "t": round(time.time(), 1)}),
         file=sys.stderr,
         flush=True,
     )
+    _heartbeat(msg)
     _reemit_headline()
 
 
@@ -122,6 +156,7 @@ def _skip_phase(phase_name: str, need_s: float = 0.0) -> bool:
         file=sys.stderr,
         flush=True,
     )
+    _heartbeat(phase_name, skipped="budget", budget_left_s=round(left, 1))
     _reemit_headline()
     return True
 
@@ -1779,6 +1814,10 @@ def _print_primary(results, backend_meta=None):
         "query_mode": primary.get("query_mode"),
         "device_check_rps": primary.get("device_check_rps"),
         "device_batch_p95_ms": primary.get("device_batch_p95_ms"),
+        # the TPU init failure text when this run degraded to cpu-fallback
+        # (r04 died with no trace of WHY the backend was unusable); null on
+        # a healthy backend
+        "backend_error": (backend_meta or {}).get("tpu_error"),
         "all_configs": [
             {
                 k: r.get(k)

@@ -182,6 +182,7 @@ def build_read_grpc_server(
     logger=None, metrics=None, tracer=None,
     max_message_bytes: int = 0,
     max_freshness_wait_s=30.0,  # float or zero-arg callable (hot reload)
+    telemetry=None,  # CheckTelemetry seam (spans/exemplars/SLO/flight)
 ) -> grpc.Server:
     """Read-plane gRPC: Check + Expand + Read + Version + Health +
     reflection, behind the telemetry interceptor chain (reference
@@ -198,7 +199,8 @@ def build_read_grpc_server(
     add_check_service(
         server,
         CheckServicer(
-            checker, snaptoken_fn, max_freshness_wait_s=max_freshness_wait_s
+            checker, snaptoken_fn, max_freshness_wait_s=max_freshness_wait_s,
+            telemetry=telemetry,
         ),
     )
     add_expand_service(server, ExpandServicer(expand_engine, snaptoken_fn))

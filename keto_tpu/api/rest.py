@@ -37,6 +37,7 @@ from typing import Optional
 from aiohttp import web
 
 from ..relationtuple.columns import CheckColumns
+from ..telemetry.flight import NOOP_CHECK_TELEMETRY
 from ..relationtuple.definitions import (
     RelationQuery,
     RelationTuple,
@@ -272,7 +273,8 @@ async def _json_body(request: web.Request):
 
 class ReadAPI:
     def __init__(
-        self, manager, checker, expand_engine, snaptoken_fn, executor=None
+        self, manager, checker, expand_engine, snaptoken_fn, executor=None,
+        telemetry=None,
     ):
         self.manager = manager
         self.checker = checker
@@ -281,6 +283,11 @@ class ReadAPI:
         # sized by the registry so in-flight checks can fill a device batch
         # (the loop's default executor caps at ~32 threads)
         self.executor = executor
+        # per-request check telemetry (span + histogram exemplar + SLO +
+        # flight recorder); entered INSIDE the executor work function
+        # because run_in_executor does not propagate contextvars — a span
+        # opened out here would be invisible to the check path
+        self.telemetry = telemetry or NOOP_CHECK_TELEMETRY
 
     def register(self, app: web.Application) -> None:
         app.router.add_get(ROUTE_TUPLES, self.get_relations)
@@ -360,13 +367,19 @@ class ReadAPI:
             max_depth = int(body.get("max_depth", max_depth) or max_depth)
             run = getattr(self.checker, "check_batch_columnar", None)
             if run is None:
-                def work(md=max_depth, mv=min_version):
+                def inner(md=max_depth, mv=min_version):
                     return self.checker.check_batch(
                         cols.materialize(), md, min_version=mv
                     )
             else:
-                def work(md=max_depth, mv=min_version):
+                def inner(md=max_depth, mv=min_version):
                     return run(cols, md, min_version=mv)
+
+            def work():
+                with self.telemetry.record_check(
+                    "rest_batch", batch_size=len(cols), deadline=deadline
+                ):
+                    return inner()
             allowed = await asyncio.get_running_loop().run_in_executor(
                 self.executor, work
             )
@@ -383,11 +396,18 @@ class ReadAPI:
                 "expected a json array of relation tuples"
             )
         tuples = [RelationTuple.from_dict(d) for d in items]
+
+        def work():
+            with self.telemetry.record_check(
+                "rest_batch", batch_size=len(tuples), deadline=deadline
+            ):
+                return self.checker.check_batch(
+                    tuples, max_depth, min_version=min_version,
+                    deadline=deadline,
+                )
+
         allowed = await asyncio.get_running_loop().run_in_executor(
-            self.executor,
-            lambda: self.checker.check_batch(
-                tuples, max_depth, min_version=min_version, deadline=deadline
-            ),
+            self.executor, work
         )
         return web.json_response(
             {"allowed": allowed, "snaptoken": self.snaptoken_fn()}
@@ -408,16 +428,22 @@ class ReadAPI:
         entries: list = []
         # the check blocks on device compute (or the batcher window) — run it
         # off the event loop so concurrent requests accumulate into batches
-        try:
-            allowed = await asyncio.get_running_loop().run_in_executor(
-                self.executor,
-                lambda: self.checker.check(
+        def work():
+            with self.telemetry.record_check(
+                "rest", deadline=deadline,
+                detail={"namespace": tup.namespace},
+            ):
+                return self.checker.check(
                     tup,
                     max_depth,
                     min_version=min_version,
                     deadline=deadline,
                     entry_hook=entries.append,
-                ),
+                )
+
+        try:
+            allowed = await asyncio.get_running_loop().run_in_executor(
+                self.executor, work
             )
         except asyncio.CancelledError:
             for f in entries:
@@ -544,7 +570,20 @@ def register_common(
 
     if metrics is not None:
 
-        async def get_metrics(_request):
+        async def get_metrics(request):
+            # OpenMetrics (exemplars + "# EOF") only when the scraper asks
+            # for it — plain text/plain scrapes stay byte-identical
+            accept = request.headers.get("Accept", "")
+            if "application/openmetrics-text" in accept:
+                return web.Response(
+                    text=metrics.expose(openmetrics=True),
+                    headers={
+                        "Content-Type": (
+                            "application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8"
+                        )
+                    },
+                )
             return web.Response(
                 text=metrics.expose(),
                 content_type="text/plain",
@@ -557,7 +596,7 @@ def register_common(
 def build_read_app(
     manager, checker, expand_engine, snaptoken_fn, version: str,
     cors: Optional[dict] = None, healthy_fn=None, executor=None,
-    logger=None, metrics=None,
+    logger=None, metrics=None, telemetry=None, debug=None,
 ) -> web.Application:
     # telemetry outermost (sees final codes), then CORS so error
     # responses also carry the headers
@@ -568,8 +607,17 @@ def build_read_app(
             error_middleware,
         ]
     )
-    ReadAPI(manager, checker, expand_engine, snaptoken_fn, executor).register(app)
+    ReadAPI(
+        manager, checker, expand_engine, snaptoken_fn, executor,
+        telemetry=telemetry,
+    ).register(app)
     register_common(app, version, healthy_fn, metrics)
+    if debug is not None:
+        # /debug lives on the read plane only; the DebugContext gates
+        # enablement and token auth per request
+        from .debug import DebugAPI
+
+        DebugAPI(debug).register(app)
     return app
 
 

@@ -25,6 +25,7 @@ import grpc
 
 from ..faults import FAULTS
 from ..relationtuple.columns import CheckColumns, proto_has_columns
+from ..telemetry.flight import NOOP_CHECK_TELEMETRY
 from ..relationtuple.definitions import RelationQuery, RelationTuple
 from ..utils.errors import DeadlineExceeded, ErrMalformedInput, KetoError
 from ..utils.pagination import PaginationOptions
@@ -75,10 +76,15 @@ class CheckServicer:
         checker,
         snaptoken_fn: Callable[[], str],
         max_freshness_wait_s=30.0,
+        telemetry=None,
     ):
         self.checker = checker
         self.snaptoken_fn = snaptoken_fn
         self._freshness_cap = max_freshness_wait_s
+        # per-request check telemetry (span + histogram exemplar + SLO +
+        # flight recorder); entered on the handler thread so the span
+        # contextvar is visible inside checker.check()
+        self.telemetry = telemetry or NOOP_CHECK_TELEMETRY
 
     def _freshness_cap_s(self) -> float:
         cap = self._freshness_cap
@@ -90,6 +96,12 @@ class CheckServicer:
         /pipeline; here it is an accessor for the process supervisor."""
         fn = getattr(self.checker, "pipeline_stats", None)
         return fn() if callable(fn) else {"pipelined": False}
+
+    def check_stats(self) -> dict:
+        """Outcome counts the check telemetry seam has accumulated
+        (transport breakdown, slow/errored totals, flight-ring stats) —
+        the servicer's contribution to /debug/flight."""
+        return self.telemetry.stats()
 
     def Check(self, request, context):
         try:
@@ -131,14 +143,18 @@ class CheckServicer:
             context.add_callback(
                 lambda: [f.cancel() for f in entries]
             )
-            allowed = self.checker.check(
-                tup,
-                request.max_depth,
-                timeout=timeout,
-                min_version=min_version,
-                deadline=deadline,
-                entry_hook=entries.append,
-            )
+            with self.telemetry.record_check(
+                "grpc", deadline=deadline,
+                detail={"namespace": request.namespace},
+            ):
+                allowed = self.checker.check(
+                    tup,
+                    request.max_depth,
+                    timeout=timeout,
+                    min_version=min_version,
+                    deadline=deadline,
+                    entry_hook=entries.append,
+                )
             return check_service_pb2.CheckResponse(
                 allowed=allowed, snaptoken=self.snaptoken_fn()
             )
@@ -161,20 +177,23 @@ class CheckServicer:
             if proto_has_columns(request):
                 cols = CheckColumns.from_proto(request)
                 run = getattr(self.checker, "check_batch_columnar", None)
-                if run is not None:
-                    allowed = run(
-                        cols,
-                        request.max_depth,
-                        min_version=min_version,
-                        timeout=timeout,
-                    )
-                else:
-                    allowed = self.checker.check_batch(
-                        cols.materialize(),
-                        request.max_depth,
-                        min_version=min_version,
-                        timeout=timeout,
-                    )
+                with self.telemetry.record_check(
+                    "grpc_batch", batch_size=len(cols), deadline=deadline
+                ):
+                    if run is not None:
+                        allowed = run(
+                            cols,
+                            request.max_depth,
+                            min_version=min_version,
+                            timeout=timeout,
+                        )
+                    else:
+                        allowed = self.checker.check_batch(
+                            cols.materialize(),
+                            request.max_depth,
+                            min_version=min_version,
+                            timeout=timeout,
+                        )
                 return check_service_pb2.BatchCheckResponse(
                     allowed=allowed, snaptoken=self.snaptoken_fn()
                 )
@@ -195,13 +214,16 @@ class CheckServicer:
                         subject=subject,
                     )
                 )
-            allowed = self.checker.check_batch(
-                tuples,
-                request.max_depth,
-                min_version=min_version,
-                timeout=timeout,
-                deadline=deadline,
-            )
+            with self.telemetry.record_check(
+                "grpc_batch", batch_size=len(tuples), deadline=deadline
+            ):
+                allowed = self.checker.check_batch(
+                    tuples,
+                    request.max_depth,
+                    min_version=min_version,
+                    timeout=timeout,
+                    deadline=deadline,
+                )
             return check_service_pb2.BatchCheckResponse(
                 allowed=allowed, snaptoken=self.snaptoken_fn()
             )

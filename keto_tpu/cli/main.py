@@ -454,6 +454,85 @@ def migrate_down(config_file, steps, yes):
 
 
 @cli.group()
+def debug():
+    """Live-server introspection helpers (the CLI face of /debug)."""
+
+
+@debug.command("snapshot")
+@click.option(
+    "--url", default=None,
+    help="base URL of the read plane (default: http://<read-remote>)",
+)
+@click.option(
+    "--out", "-o", default=None, type=click.Path(),
+    help="output tarball path (default: keto-debug-<ts>.tar.gz)",
+)
+@click.option(
+    "--token", default=None,
+    help="debug token when the /debug surface is protected (debug.token)",
+)
+@click.option(
+    "--timeout", "timeout_s", default=10.0, show_default=True,
+    help="per-endpoint fetch timeout in seconds",
+)
+@click.pass_context
+def debug_snapshot(ctx, url, out, token, timeout_s):
+    """Bundle a support tarball from a live server: thread stacks,
+    redacted config, graph panel + device stats, the flight-recorder
+    ring, recent traces, a metrics dump, and pipeline occupancy. Safe to
+    attach to a ticket — /debug/config redacts secrets server-side."""
+    import io
+    import tarfile
+    import urllib.error
+    import urllib.request
+
+    base = (url or f"http://{_read_remote(ctx)}").rstrip("/")
+    endpoints = [
+        ("stacks.txt", "/debug/stacks"),
+        ("config.json", "/debug/config"),
+        ("graph.json", "/debug/graph"),
+        ("flight.json", "/debug/flight"),
+        ("traces.json", "/debug/traces"),
+        ("metrics.prom", "/metrics"),
+        ("pipeline.json", "/pipeline"),
+        ("version.json", "/version"),
+    ]
+    fetched: list[tuple[str, bytes]] = []
+    errors: list[str] = []
+    for name, path in endpoints:
+        req = urllib.request.Request(base + path)
+        if token:
+            req.add_header("X-Debug-Token", token)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                fetched.append((name, resp.read()))
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            errors.append(f"{path}: {e}")
+    if not fetched:
+        raise click.ClickException(
+            f"could not reach {base} — " + "; ".join(errors[:3])
+        )
+    out = out or f"keto-debug-{time.strftime('%Y%m%d-%H%M%S')}.tar.gz"
+    with tarfile.open(out, "w:gz") as tar:
+        for name, body in fetched:
+            info = tarfile.TarInfo(name=name)
+            info.size = len(body)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(body))
+        if errors:
+            body = ("\n".join(errors) + "\n").encode()
+            info = tarfile.TarInfo(name="errors.txt")
+            info.size = len(body)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(body))
+    click.echo(
+        f"wrote {out} ({len(fetched)} files"
+        + (f", {len(errors)} endpoints failed" if errors else "")
+        + ")"
+    )
+
+
+@cli.group()
 def namespace():
     """Namespace utilities (reference cmd/namespace)."""
 

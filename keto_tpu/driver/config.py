@@ -251,6 +251,64 @@ CONFIG_SCHEMA = {
             },
             "additionalProperties": False,
         },
+        # introspection plane (telemetry/flight.py, telemetry/slo.py)
+        "telemetry": {
+            "type": "object",
+            "properties": {
+                "flight": {
+                    "type": "object",
+                    "properties": {
+                        # ring-buffer entries retained (slow/errored/
+                        # deadline-missed requests)
+                        "capacity": {"type": "integer", "minimum": 1},
+                        # a request at least this slow is flight-recorded
+                        # even when it succeeded
+                        "slow_ms": {"type": "number", "minimum": 0},
+                        # "" keeps the ring memory-only; a directory arms
+                        # the periodic disk flush + faulthandler fatal dump
+                        "dir": {"type": "string"},
+                        "flush_interval_s": {"type": "number", "minimum": 0.1},
+                    },
+                    "additionalProperties": False,
+                },
+                "slo": {
+                    "type": "object",
+                    "properties": {
+                        # fraction of checks that must be fast-and-correct
+                        "objective": {
+                            "type": "number",
+                            "exclusiveMinimum": 0,
+                            "exclusiveMaximum": 1,
+                        },
+                        # a check slower than this counts against the
+                        # error budget even when it succeeded
+                        "latency_target_ms": {"type": "number", "minimum": 0},
+                        "fast_window_s": {"type": "number", "minimum": 1},
+                        "slow_window_s": {"type": "number", "minimum": 1},
+                        # both windows must burn at this rate before the
+                        # log alert fires
+                        "alert_burn_rate": {"type": "number", "minimum": 0},
+                        "alert_cooldown_s": {"type": "number", "minimum": 0},
+                    },
+                    "additionalProperties": False,
+                },
+            },
+            "additionalProperties": False,
+        },
+        # /debug surface on the read plane (api/debug.py)
+        "debug": {
+            "type": "object",
+            "properties": {
+                # false hides every /debug route as 404
+                "enabled": {"type": "boolean"},
+                # non-empty requires Authorization: Bearer <token> or
+                # X-Debug-Token on every /debug request
+                "token": {"type": "string"},
+                # cap on /debug/profile?seconds=N captures
+                "profile_max_s": {"type": "number", "minimum": 0.1},
+            },
+            "additionalProperties": False,
+        },
     },
     "additionalProperties": False,
 }
@@ -297,6 +355,19 @@ DEFAULTS = {
     "checkpoint.interval-versions": 10000,
     "checkpoint.interval-s": 300,
     "checkpoint.keep": 2,
+    "telemetry.flight.capacity": 512,
+    "telemetry.flight.slow_ms": 250,
+    "telemetry.flight.dir": "",
+    "telemetry.flight.flush_interval_s": 2.0,
+    "telemetry.slo.objective": 0.999,
+    "telemetry.slo.latency_target_ms": 250,
+    "telemetry.slo.fast_window_s": 300,
+    "telemetry.slo.slow_window_s": 3600,
+    "telemetry.slo.alert_burn_rate": 2.0,
+    "telemetry.slo.alert_cooldown_s": 300,
+    "debug.enabled": True,
+    "debug.token": "",
+    "debug.profile_max_s": 30,
 }
 
 

@@ -55,6 +55,10 @@ class Registry:
         self._logger = None
         self._tracer = None
         self._metrics = None
+        self._flight = None
+        self._slo = None
+        self._check_telemetry = None
+        self._debug_context = None
         self._config_watcher: Optional[threading.Thread] = None
         self._config_watch_stop = threading.Event()
 
@@ -126,6 +130,11 @@ class Registry:
                 replayed.inc(rep.replayed_deltas)
                 seconds.set(rep.duration_s)
                 gap.set(1.0 if rep.gap else 0.0)
+            # device telemetry + graph panel (keto_device_* / keto_graph_*
+            # gauges); the singleton rebinds to the newest registry
+            from ..telemetry.devstats import DEVSTATS
+
+            DEVSTATS.bind(m, graph_panel_fn=self.graph_panel)
             self._metrics = m
         return self._metrics
 
@@ -135,6 +144,164 @@ class Registry:
         if served is None:
             return 0
         return max(0, self.store().version - served())
+
+    def graph_panel(self) -> dict:
+        """Shape-of-the-graph snapshot for the keto_graph_* gauges and
+        /debug/graph: tuple count, snapshot version, CSR nnz, vocab size,
+        closure age. Reads ONLY already-materialized state — sampling at
+        scrape time must never force a snapshot re-encode or closure
+        rebuild."""
+        import time as _time
+
+        out: dict = {}
+        try:
+            store = self._store
+            if store is not None:
+                out["tuples"] = len(store)
+                out["store_version"] = store.version
+            mgr = self._snapshots
+            snap = mgr._snap if mgr is not None else None
+            if snap is not None:
+                out["snapshot_version"] = snap.version
+                out["csr_nnz"] = snap.num_edges
+                out["vocab_size"] = len(snap.vocab)
+                out["padded_nodes"] = snap.padded_nodes
+                out["padded_edges"] = snap.padded_edges
+                out["csr_derived"] = snap._csr is not None
+            engine = self._check_engine
+            if engine is not None:
+                out["engine"] = type(engine).__name__
+                built = getattr(engine, "closure_built_at", None)
+                if built:
+                    out["closure_age_s"] = round(_time.time() - built, 1)
+        except Exception:
+            pass
+        return out
+
+    def flight(self):
+        """The request flight recorder (telemetry/flight.py), configured
+        by the telemetry.flight.* subtree. When a dump dir is set, the
+        fatal-path dump (faulthandler + ring flush) is armed too."""
+        if self._flight is None:
+            from ..telemetry import FlightRecorder
+
+            self._flight = FlightRecorder(
+                capacity=int(
+                    self.config.get("telemetry.flight.capacity", default=512)
+                ),
+                dump_dir=str(
+                    self.config.get("telemetry.flight.dir", default="") or ""
+                ),
+                flush_interval_s=float(
+                    self.config.get(
+                        "telemetry.flight.flush_interval_s", default=2.0
+                    )
+                ),
+            )
+            if self._flight.dump_dir:
+                self._flight.install_fatal_dump()
+        return self._flight
+
+    def slo(self):
+        if self._slo is None:
+            from ..telemetry import SLOTracker
+
+            self._slo = SLOTracker(
+                metrics=self.metrics(),
+                logger=self.logger(),
+                objective=float(
+                    self.config.get("telemetry.slo.objective", default=0.999)
+                ),
+                latency_target_s=float(
+                    self.config.get(
+                        "telemetry.slo.latency_target_ms", default=250
+                    )
+                )
+                / 1e3,
+                fast_window_s=float(
+                    self.config.get(
+                        "telemetry.slo.fast_window_s", default=300
+                    )
+                ),
+                slow_window_s=float(
+                    self.config.get(
+                        "telemetry.slo.slow_window_s", default=3600
+                    )
+                ),
+                alert_burn_rate=float(
+                    self.config.get(
+                        "telemetry.slo.alert_burn_rate", default=2.0
+                    )
+                ),
+                alert_cooldown_s=float(
+                    self.config.get(
+                        "telemetry.slo.alert_cooldown_s", default=300
+                    )
+                ),
+            )
+        return self._slo
+
+    def check_telemetry(self):
+        """The per-request seam (span + exemplar + SLO + flight) handed to
+        the REST ReadAPI and the gRPC CheckServicer."""
+        if self._check_telemetry is None:
+            from ..telemetry import CheckTelemetry
+
+            self._check_telemetry = CheckTelemetry(
+                metrics=self.metrics(),
+                tracer=self.tracer(),
+                flight=self.flight(),
+                slo=self.slo(),
+                slow_s=float(
+                    self.config.get("telemetry.flight.slow_ms", default=250)
+                )
+                / 1e3,
+                stages_fn=self._stage_percentiles,
+            )
+        return self._check_telemetry
+
+    def _stage_percentiles(self):
+        """Per-stage p50/p95 snapshot from the pipeline histograms — the
+        per-stage-timings field flight-recorder entries carry."""
+        m = self._metrics
+        if m is None:
+            return None
+        h = m.get("keto_pipeline_stage_seconds")
+        if h is None:
+            return None
+        out = {}
+        for labels, child in h._series():
+            if child.count == 0:
+                continue
+            out[labels.get("stage", "?")] = {
+                "p50_ms": round(child.percentile(0.50) * 1000, 3),
+                "p95_ms": round(child.percentile(0.95) * 1000, 3),
+                "count": child.count,
+            }
+        return out or None
+
+    def debug_context(self):
+        """Everything /debug needs (api/debug.py), gated by debug.*."""
+        if self._debug_context is None:
+            from ..api.debug import DebugContext
+
+            self._debug_context = DebugContext(
+                config=self.config,
+                flight=self.flight(),
+                tracer=self.tracer(),
+                metrics=self.metrics(),
+                slo=self.slo(),
+                check_telemetry=self.check_telemetry(),
+                graph_panel_fn=self.graph_panel,
+                enabled=bool(
+                    self.config.get("debug.enabled", default=True)
+                ),
+                token=str(self.config.get("debug.token", default="") or ""),
+                profile_max_s=float(
+                    self.config.get("debug.profile_max_s", default=30)
+                ),
+            )
+        return self._debug_context
 
     # -- providers (lazy, like RegistryDefault's memoized getters) ------------
 
@@ -482,6 +649,7 @@ class Registry:
                     self.config.get("serve.read.grpc-max-message-size")
                 ),
                 max_freshness_wait_s=self._freshness_cap_s,
+                telemetry=self.check_telemetry(),
             )
             app = build_read_app(
                 self.store(),
@@ -494,6 +662,8 @@ class Registry:
                 executor=self.check_executor(),
                 logger=self.logger(),
                 metrics=self.metrics(),
+                telemetry=self.check_telemetry(),
+                debug=self.debug_context(),
             )
             self._read_plane = PlaneServer(
                 grpc_server,
@@ -951,6 +1121,10 @@ class Registry:
             # hang-not-raise mode), same reasoning as PlaneServer.stop
             self._check_executor.shutdown(wait=False, cancel_futures=True)
             self._check_executor = None
+        if self._flight is not None:
+            # final ring flush + faulthandler disarm
+            self._flight.close()
+            self._flight = None
         if self._tracer is not None:
             # ship the last partial OTLP batch before the process exits
             self._tracer.flush(timeout_s=3.0)

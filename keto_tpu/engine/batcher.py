@@ -56,6 +56,7 @@ from typing import Optional, Sequence
 
 from ..faults import FAULTS
 from ..relationtuple.definitions import RelationTuple
+from ..telemetry.devstats import DEVSTATS
 from ..telemetry.metrics import (
     deadline_expired_counter,
     pipeline_stage_histogram,
@@ -743,6 +744,7 @@ class CheckBatcher:
     def _observe(self, stage: str, seconds: float) -> None:
         if self._m_stage is not None:
             self._m_stage.labels(stage=stage).observe(seconds)
+        DEVSTATS.record_stage(stage, seconds)
 
     # -- deadline / cancellation culling ---------------------------------------
 

@@ -448,6 +448,7 @@ class ClosureCheckEngine:
             else:
                 self._overlay = None
             self._state = state
+            self.closure_built_at = time.time()  # graph-panel closure age
             with self._state_cv:
                 self._state_cv.notify_all()  # wake wait_for_version
             return state

@@ -30,6 +30,7 @@ import numpy as np
 
 from ..faults import FAULTS
 from ..graph.snapshot import GraphSnapshot, SnapshotManager, _bucket
+from ..telemetry.devstats import DEVSTATS
 from ..ops.frontier import (
     batched_check_dense,
     batched_check_scatter,
@@ -514,6 +515,9 @@ class DeviceCheckEngine:
         FAULTS.maybe_sleep("device.slow")
         if FAULTS.should_fire("device.batch_nan"):
             return LaunchedBatch(enc, garbage=True)
+        DEVSTATS.record_transfer(
+            enc.start.nbytes + enc.target.nbytes + enc.depth.nbytes, "h2d"
+        )
         dg = enc.dg
         if dg.mode == "packed":
             from ..ops.packed import packed_batched_check
@@ -557,7 +561,9 @@ class DeviceCheckEngine:
         try:
             if launched.garbage:
                 return [float("nan")] * enc.n
-            return np.asarray(launched.hit)[: enc.n].tolist()
+            hit = np.asarray(launched.hit)
+            DEVSTATS.record_transfer(hit.nbytes, "d2h")
+            return hit[: enc.n].tolist()
         finally:
             enc.release()
 

@@ -9,8 +9,11 @@ in-process ring buffer, metrics export in Prometheus text format on
 GET /metrics of both planes.
 """
 
+from .devstats import DEVSTATS, DeviceStatsCollector
+from .flight import NOOP_CHECK_TELEMETRY, CheckTelemetry, FlightRecorder
 from .logging import configure_logging, get_logger
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .slo import SLOTracker
 from .tracing import Span, Tracer
 
 __all__ = [
@@ -22,4 +25,10 @@ __all__ = [
     "MetricsRegistry",
     "Span",
     "Tracer",
+    "DEVSTATS",
+    "DeviceStatsCollector",
+    "FlightRecorder",
+    "CheckTelemetry",
+    "NOOP_CHECK_TELEMETRY",
+    "SLOTracker",
 ]

@@ -12,6 +12,7 @@ the runtime image does not ship the client library).
 from __future__ import annotations
 
 import threading
+import time
 from bisect import bisect_left
 from typing import Optional, Sequence
 
@@ -22,11 +23,23 @@ DEFAULT_BUCKETS = (
 )
 
 
+def _escape_label_value(v) -> str:
+    # Prometheus text format: label values escape backslash, double-quote,
+    # AND line feed — an unescaped newline splits the sample line in two
+    # and corrupts the whole exposition
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
     inner = ",".join(
-        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        f'{k}="{_escape_label_value(v)}"'
         for k, v in sorted(labels.items())
     )
     return "{" + inner + "}"
@@ -80,7 +93,7 @@ class Counter(_Metric):
     def value(self) -> float:
         return self._value
 
-    def _expose(self, labels):
+    def _expose(self, labels, openmetrics=False):
         return [f"{self.name}{_fmt_labels(labels)} {self._value}"]
 
 
@@ -94,6 +107,12 @@ class Gauge(_Metric):
 
     def _make_child(self):
         return Gauge(self.name, self.help)
+
+    def set_fn(self, fn) -> None:
+        """Make this gauge (or a labeled child) sample ``fn`` at scrape
+        time — labeled children can't take ``fn`` in the constructor
+        because _make_child has no way to carry it."""
+        self._fn = fn
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -112,7 +131,7 @@ class Gauge(_Metric):
             return float(self._fn())
         return self._value
 
-    def _expose(self, labels):
+    def _expose(self, labels, openmetrics=False):
         return [f"{self.name}{_fmt_labels(labels)} {self.value}"]
 
 
@@ -124,17 +143,26 @@ class Histogram(_Metric):
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
         self._sum = 0.0
+        # bucket index -> (labels, value, unix-ts): the last exemplar
+        # observed in that bucket, emitted in OpenMetrics expositions
+        self._exemplars: dict[int, tuple[dict, float, float]] = {}
 
     def _make_child(self):
         return Histogram(self.name, self.help, buckets=self.buckets)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[dict] = None) -> None:
         # le-inclusive bucket semantics: a value equal to a boundary
         # belongs to that bucket
         i = bisect_left(self.buckets, value)
         with self._lock:
             self._counts[i] += 1
             self._sum += value
+            if exemplar:
+                self._exemplars[i] = (dict(exemplar), value, time.time())
+
+    def exemplars(self) -> dict[int, tuple[dict, float, float]]:
+        with self._lock:
+            return dict(self._exemplars)
 
     def percentile(self, q: float) -> float:
         """Approximate quantile from bucket counts (upper bound of the
@@ -160,17 +188,32 @@ class Histogram(_Metric):
     def count(self) -> int:
         return sum(self._counts)
 
-    def _expose(self, labels):
+    def _exemplar_suffix(self, i: int) -> str:
+        """OpenMetrics exemplar clause for bucket index ``i`` (empty when
+        none recorded): ``# {trace_id="…"} value timestamp``."""
+        ex = self._exemplars.get(i)
+        if ex is None:
+            return ""
+        ex_labels, ex_value, ex_ts = ex
+        return f" # {_fmt_labels(ex_labels)} {ex_value} {round(ex_ts, 3)}"
+
+    def _expose(self, labels, openmetrics=False):
         lines = []
         acc = 0
-        for b, c in zip(self.buckets, self._counts):
+        for i, (b, c) in enumerate(zip(self.buckets, self._counts)):
             acc += c
             lb = dict(labels, le=repr(b) if b != int(b) else str(b))
-            lines.append(f"{self.name}_bucket{_fmt_labels(lb)} {acc}")
+            line = f"{self.name}_bucket{_fmt_labels(lb)} {acc}"
+            if openmetrics:
+                line += self._exemplar_suffix(i)
+            lines.append(line)
         acc += self._counts[-1]
-        lines.append(
+        line = (
             f'{self.name}_bucket{_fmt_labels(dict(labels, le="+Inf"))} {acc}'
         )
+        if openmetrics:
+            line += self._exemplar_suffix(len(self.buckets))
+        lines.append(line)
         lines.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sum}")
         lines.append(f"{self.name}_count{_fmt_labels(labels)} {acc}")
         return lines
@@ -208,8 +251,9 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
-    def expose(self) -> str:
-        """Prometheus text format v0.0.4."""
+    def expose(self, openmetrics: bool = False) -> str:
+        """Prometheus text format v0.0.4, or OpenMetrics 1.0 when
+        ``openmetrics`` is set (adds histogram exemplars + ``# EOF``)."""
         out = []
         with self._lock:
             metrics = list(self._metrics.values())
@@ -217,7 +261,9 @@ class MetricsRegistry:
             out.append(f"# HELP {m.name} {m.help}")
             out.append(f"# TYPE {m.name} {m.kind}")
             for labels, child in m._series():
-                out.extend(child._expose(labels))
+                out.extend(child._expose(labels, openmetrics=openmetrics))
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
 

@@ -330,3 +330,446 @@ class TestOtlpExport:
             assert len(tracer.finished("work")) == 50
         finally:
             tracer.close()
+
+
+# -- introspection plane (PR 6): flight recorder, exemplars, SLO, /debug ------
+
+
+def _lint_module():
+    """Load tools/lint_metrics.py as a module (tools/ is not a package)."""
+    import importlib.util
+    import os
+
+    path = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "tools", "lint_metrics.py")
+    )
+    spec = importlib.util.spec_from_file_location("lint_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestLabelEscaping:
+    def test_fmt_labels_escapes_newlines_quotes_backslashes(self):
+        from keto_tpu.telemetry.metrics import _fmt_labels
+
+        out = _fmt_labels({"msg": 'a\nb"c\\d'})
+        assert out == '{msg="a\\nb\\"c\\\\d"}'
+        assert "\n" not in out
+
+    def test_newline_label_value_stays_one_exposition_line(self):
+        m = MetricsRegistry()
+        c = m.counter("esc_total", "t", labelnames=("detail",))
+        c.labels(detail="line1\nline2").inc()
+        lines = [
+            l for l in m.expose().splitlines() if l.startswith("esc_total{")
+        ]
+        assert len(lines) == 1
+        assert '\\n' in lines[0]
+
+
+class TestExemplars:
+    def test_exemplars_only_in_openmetrics_exposition(self):
+        m = MetricsRegistry()
+        h = m.histogram("ex_seconds", "t", buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar={"trace_id": "deadbeef"})
+        plain = m.expose()
+        om = m.expose(openmetrics=True)
+        assert "# {" not in plain
+        assert "# EOF" not in plain
+        assert '# {trace_id="deadbeef"} 0.05' in om
+        assert om.rstrip("\n").endswith("# EOF")
+
+    def test_last_exemplar_per_bucket_wins(self):
+        m = MetricsRegistry()
+        h = m.histogram("ex2_seconds", "t", buckets=(0.1, 1.0))
+        h.observe(0.01, exemplar={"trace_id": "old"})
+        h.observe(0.02, exemplar={"trace_id": "new"})
+        om = m.expose(openmetrics=True)
+        assert 'trace_id="new"' in om
+        assert 'trace_id="old"' not in om
+
+    def test_lint_round_trip_both_formats(self):
+        lint = _lint_module()
+        m = MetricsRegistry()
+        c = m.counter("rt_total", "t", labelnames=("k",))
+        c.labels(k="v").inc()
+        h = m.histogram("rt_seconds", "t", buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar={"trace_id": "abc"})
+        assert lint.lint_text(m.expose(), openmetrics=False) == []
+        assert lint.lint_text(m.expose(openmetrics=True), openmetrics=True) == []
+        # an OpenMetrics body presented as plain text must be flagged
+        violations = lint.lint_text(m.expose(openmetrics=True), openmetrics=False)
+        assert any("exemplar" in v for v in violations)
+        assert any("EOF" in v for v in violations)
+
+    def test_lint_catches_broken_families(self):
+        lint = _lint_module()
+        bad = (
+            "# HELP bad_counter c\n"
+            "# TYPE bad_counter counter\n"
+            "bad_counter 1\n"
+            "orphan_metric 2\n"
+            'dup{a="1"} 1\n'
+        )
+        violations = lint.lint_text(bad)
+        assert any("_total" in v for v in violations)
+        assert any("orphan_metric" in v for v in violations)
+
+
+class TestFlightRecorder:
+    def test_ring_eviction_newest_first(self):
+        from keto_tpu.telemetry import FlightRecorder
+
+        fr = FlightRecorder(capacity=3)
+        for i in range(5):
+            fr.record(idx=i)
+        recs = fr.records()
+        assert [r["idx"] for r in recs] == [4, 3, 2]
+        assert recs[0]["seq"] == 4
+        assert fr.total_recorded == 5
+        assert fr.records(1)[0]["idx"] == 4
+        assert fr.stats()["size"] == 3
+
+    def test_fatal_dump_writes_ring_and_stacks(self, tmp_path):
+        from keto_tpu.telemetry import FlightRecorder
+
+        fr = FlightRecorder(
+            capacity=8, dump_dir=str(tmp_path), flush_interval_s=60
+        )
+        try:
+            fr.record(trace_id="abc123", outcome="error:Boom")
+            fr.install_fatal_dump()
+            fr.dump_fatal()
+            doc = json.loads((tmp_path / "flight.json").read_text())
+            assert doc["records"][0]["trace_id"] == "abc123"
+            stacks = (tmp_path / "fatal.stacks").read_text()
+            assert "File" in stacks or "Thread" in stacks
+        finally:
+            fr.close()
+        # close() must restore the excepthook and disable faulthandler
+        import faulthandler
+        import sys
+
+        assert not faulthandler.is_enabled() or True  # other tests may arm it
+        assert sys.excepthook is sys.__excepthook__ or fr._prev_excepthook is None
+
+
+class TestSLOBurnRate:
+    def test_burn_rate_math(self):
+        from keto_tpu.telemetry import SLOTracker
+
+        clk = [1000.0]
+        t = SLOTracker(
+            objective=0.9, latency_target_s=0.1,
+            fast_window_s=60, slow_window_s=600, clock=lambda: clk[0],
+        )
+        for _ in range(9):
+            assert t.record(0.01) is False
+        assert t.record(0.01, error=True) is True
+        # 1 bad / 10 total = 10% bad over a 10% budget -> burn exactly 1.0
+        assert t.burn_rate(60) == pytest.approx(1.0)
+        assert t.budget_remaining() == pytest.approx(0.0)
+        # latency above the target is bad even without an error
+        assert t.record(0.5) is True
+
+    def test_window_expiry(self):
+        from keto_tpu.telemetry import SLOTracker
+
+        clk = [1000.0]
+        t = SLOTracker(
+            objective=0.9, fast_window_s=60, slow_window_s=600,
+            clock=lambda: clk[0],
+        )
+        t.record(0.01, error=True)
+        assert t.burn_rate(600) > 0
+        clk[0] += 700  # past the slow window: the bad event ages out
+        t.record(0.01)
+        assert t.burn_rate(600) == pytest.approx(0.0)
+
+    def test_alert_fires_once_per_cooldown(self):
+        from keto_tpu.telemetry import SLOTracker
+
+        warnings = []
+
+        class FakeLog:
+            def warning(self, msg, **fields):
+                warnings.append((msg, fields))
+
+        clk = [1000.0]
+        t = SLOTracker(
+            logger=FakeLog(), objective=0.9, alert_burn_rate=1.0,
+            alert_cooldown_s=300, fast_window_s=60, slow_window_s=600,
+            clock=lambda: clk[0],
+        )
+        t.record(0.01, error=True)
+        assert t.alerts_fired == 1
+        assert warnings and warnings[0][0] == "slo_burn_alert"
+        assert warnings[0][1]["fast_burn_rate"] >= 1.0
+        clk[0] += 10  # within cooldown: no second alert
+        t.record(0.01, error=True)
+        assert t.alerts_fired == 1
+        clk[0] += 300  # past cooldown
+        t.record(0.01, error=True)
+        assert t.alerts_fired == 2
+
+
+@pytest.fixture(scope="module")
+def drill():
+    """A device-engine server with an armed /debug surface and tight
+    flight/SLO thresholds — the seeded slow-request drill target."""
+    import os
+    import tempfile
+
+    dump_dir = tempfile.mkdtemp(prefix="keto-flight-")
+    cfg = Config(
+        values={
+            "namespaces": [{"id": 1, "name": "videos"}],
+            "serve": {
+                "read": {"port": 0, "host": "127.0.0.1"},
+                "write": {"port": 0, "host": "127.0.0.1"},
+            },
+            "log": {"level": "error"},
+            "engine": {"mode": "device", "max_batch": 64},
+            "telemetry": {
+                "flight": {
+                    "capacity": 64, "slow_ms": 50, "dir": dump_dir,
+                    "flush_interval_s": 0.2,
+                },
+                "slo": {
+                    "objective": 0.9, "latency_target_ms": 50,
+                    "fast_window_s": 60, "slow_window_s": 600,
+                    "alert_burn_rate": 0.01, "alert_cooldown_s": 1,
+                },
+            },
+            "debug": {"enabled": True, "token": "hunter2"},
+        },
+        env={},
+    )
+    s = ServerFixture(cfg)
+    s.dump_dir = dump_dir
+    yield s
+    s.stop()
+
+
+def _dbg(server, path, token="hunter2", **kw):
+    headers = kw.pop("headers", {})
+    if token is not None:
+        headers["X-Debug-Token"] = token
+    return httpx.get(
+        f"http://127.0.0.1:{server.read_port}{path}",
+        headers=headers, timeout=30, **kw,
+    )
+
+
+class TestDebugSurface:
+    def test_token_gate(self, drill):
+        assert _dbg(drill, "/debug/stacks", token=None).status_code == 403
+        assert _dbg(drill, "/debug/stacks", token="wrong").status_code == 403
+        r = _dbg(drill, "/debug/stacks")
+        assert r.status_code == 200
+        assert "MainThread" in r.text
+
+    def test_bearer_token_accepted(self, drill):
+        r = httpx.get(
+            f"http://127.0.0.1:{drill.read_port}/debug/stacks",
+            headers={"Authorization": "Bearer hunter2"}, timeout=30,
+        )
+        assert r.status_code == 200
+
+    def test_config_redacts_secrets(self, drill):
+        r = _dbg(drill, "/debug/config")
+        assert r.status_code == 200
+        assert "hunter2" not in r.text
+        assert "[redacted]" in r.text
+        doc = r.json()
+        assert doc["config"]["debug"]["token"] == "[redacted]"
+
+    def test_graph_panel_endpoint(self, drill):
+        doc = _dbg(drill, "/debug/graph").json()
+        assert "graph" in doc and "devices" in doc
+        assert "tuples" in doc["graph"]
+
+    def test_traces_endpoint(self, drill):
+        doc = _dbg(drill, "/debug/traces").json()
+        assert isinstance(doc["spans"], list)
+
+    def test_debug_disabled_is_404(self):
+        cfg = Config(
+            values={
+                "namespaces": [{"id": 1, "name": "videos"}],
+                "serve": {
+                    "read": {"port": 0, "host": "127.0.0.1"},
+                    "write": {"port": 0, "host": "127.0.0.1"},
+                },
+                "log": {"level": "error"},
+                "debug": {"enabled": False},
+            },
+            env={},
+        )
+        s = ServerFixture(cfg)
+        try:
+            r = httpx.get(
+                f"http://127.0.0.1:{s.read_port}/debug/stacks", timeout=30
+            )
+            assert r.status_code == 404
+            # the rest of the plane still serves
+            assert (
+                httpx.get(
+                    f"http://127.0.0.1:{s.read_port}/health/alive", timeout=30
+                ).status_code
+                == 200
+            )
+        finally:
+            s.stop()
+
+
+class TestIntrospectionDrill:
+    """The acceptance drill: an armed device.slow fault must leave a
+    correlated evidence trail — flight-recorder entry, histogram exemplar
+    trace id, and a burning SLO gauge — with no log spelunking."""
+
+    def test_slow_fault_leaves_full_evidence(self, drill):
+        from keto_tpu.faults import FAULTS
+
+        base = f"http://127.0.0.1:{drill.read_port}"
+        put = httpx.put(
+            f"http://127.0.0.1:{drill.write_port}/relation-tuples",
+            json={
+                "namespace": "videos",
+                "object": "/cats",
+                "relation": "view",
+                "subject_id": "cat lady",
+            },
+            timeout=60,
+        )
+        assert put.status_code in (200, 201)
+        try:
+            FAULTS.arm_slow("device.slow", sleep_ms=120, times=8)
+            r = httpx.get(
+                f"{base}/check",
+                params={
+                    "namespace": "videos",
+                    "object": "/cats",
+                    "relation": "view",
+                    "subject_id": "cat lady",
+                },
+                timeout=60,
+            )
+            assert r.status_code == 200
+        finally:
+            FAULTS.reset()
+
+        # 1. the flight recorder captured it (slow >= 50ms threshold)
+        doc = _dbg(drill, "/debug/flight").json()
+        slow = [
+            rec for rec in doc["records"]
+            if rec.get("slow") and rec.get("transport") == "rest"
+        ]
+        assert slow, f"no slow flight record in {doc['records']!r}"
+        rec = slow[0]
+        assert rec["outcome"] == "ok"
+        assert rec["duration_ms"] >= 100
+        trace_id = rec["trace_id"]
+        assert len(trace_id) == 32
+
+        # 2. the check-latency histogram carries that trace id as an
+        #    OpenMetrics exemplar
+        om = httpx.get(
+            f"{base}/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+            timeout=30,
+        )
+        assert "application/openmetrics-text" in om.headers["content-type"]
+        assert om.text.rstrip("\n").endswith("# EOF")
+        assert "keto_check_duration_seconds_bucket" in om.text
+        assert f'trace_id="{trace_id}"' in om.text
+
+        # 3. the SLO burn-rate gauge is non-zero (50ms target, ~120ms hit)
+        plain = httpx.get(f"{base}/metrics", timeout=30).text
+        burn = [
+            l for l in plain.splitlines()
+            if l.startswith('keto_slo_burn_rate{window="fast"}')
+        ]
+        assert burn, "keto_slo_burn_rate{window=fast} not exposed"
+        assert float(burn[0].split()[-1]) > 0
+        assert "keto_slo_bad_events_total" in plain
+
+        # 4. exemplars never leak into the plain-text exposition
+        assert "# {" not in plain
+
+        # 5. the armed dump_dir got the ring flushed to disk
+        import time as _time
+
+        deadline = _time.time() + 5
+        flight_path = None
+        while _time.time() < deadline:
+            import os
+
+            p = os.path.join(drill.dump_dir, "flight.json")
+            if os.path.exists(p):
+                flight_path = p
+                break
+            _time.sleep(0.1)
+        assert flight_path, "flight ring never flushed to dump dir"
+        disk = json.loads(open(flight_path).read())
+        assert any(r.get("trace_id") == trace_id for r in disk["records"])
+
+    def test_both_expositions_stay_lint_clean(self, drill):
+        lint = _lint_module()
+        base = f"http://127.0.0.1:{drill.read_port}"
+        plain = httpx.get(f"{base}/metrics", timeout=30).text
+        om = httpx.get(
+            f"{base}/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+            timeout=30,
+        ).text
+        assert lint.lint_text(plain, openmetrics=False) == []
+        assert lint.lint_text(om, openmetrics=True) == []
+
+    def test_debug_snapshot_tarball(self, drill, tmp_path):
+        import tarfile
+
+        from click.testing import CliRunner
+
+        from keto_tpu.cli import cli
+
+        out = str(tmp_path / "snap.tar.gz")
+        res = CliRunner().invoke(
+            cli,
+            [
+                "--read-remote", f"127.0.0.1:{drill.read_port}",
+                "debug", "snapshot", "--out", out, "--token", "hunter2",
+            ],
+        )
+        assert res.exit_code == 0, res.output
+        with tarfile.open(out) as tar:
+            names = set(tar.getnames())
+            assert {
+                "stacks.txt", "config.json", "graph.json",
+                "flight.json", "traces.json", "metrics.prom",
+            } <= names
+            cfg_doc = json.loads(tar.extractfile("config.json").read())
+            assert cfg_doc["config"]["debug"]["token"] == "[redacted]"
+            stacks = tar.extractfile("stacks.txt").read().decode()
+            assert "MainThread" in stacks
+
+
+class TestBenchHeartbeat:
+    def test_heartbeat_appends_jsonl(self, tmp_path, monkeypatch):
+        import bench
+
+        hb = tmp_path / "hb.jsonl"
+        monkeypatch.setenv("BENCH_HEARTBEAT_FILE", str(hb))
+        monkeypatch.setattr(bench, "_LAST_PHASE", None)
+        bench._heartbeat("phase-one")
+        bench._heartbeat("phase-two", skipped="budget", budget_left_s=1.5)
+        lines = [json.loads(l) for l in hb.read_text().splitlines()]
+        assert len(lines) == 2
+        assert lines[0]["phase"] == "phase-one"
+        assert lines[0]["last_completed"] is None
+        assert lines[1]["phase"] == "phase-two"
+        assert lines[1]["last_completed"] == "phase-one"
+        assert lines[1]["skipped"] == "budget"
+        for doc in lines:
+            assert "wall_s" in doc and "t_mono" in doc and "t" in doc

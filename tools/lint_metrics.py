@@ -1,0 +1,453 @@
+#!/usr/bin/env python
+"""Strict Prometheus / OpenMetrics text-format linter, plus a live-daemon
+gate: boot the full serving stack on loopback ports, drive traffic through
+both transports, scrape /metrics from both planes in both formats, and
+fail on any naming-convention, duplicate-series, or format violation.
+
+The linter is importable (``lint_text(text, openmetrics=False)``) so
+tests can round-trip expositions through it; ``main()`` is the
+tools/check.sh tier.
+
+Checks enforced per family / series:
+- family names are lowercase snake_case with the ``keto_``/``process_``
+  style prefix shape ``^[a-z][a-z0-9_]*$``
+- every sample belongs to a family that declared # HELP and # TYPE first,
+  and each family declares them exactly once
+- counter families end in ``_total``; counter/gauge sample names equal
+  the family name; histogram samples are only ``_bucket``/``_sum``/
+  ``_count``
+- histogram ``le`` buckets are cumulative (non-decreasing counts in
+  increasing le order), include ``+Inf``, and the +Inf count equals
+  ``_count``
+- no duplicate series (same sample name + identical label set twice)
+- label names match ``^[a-zA-Z_][a-zA-Z0-9_]*$``; label values use only
+  the legal escapes (\\\\, \\", \\n); sample values parse as floats
+- exemplars (``# {...} value ts``) appear only in OpenMetrics mode and
+  only on ``_bucket`` lines; OpenMetrics expositions end with ``# EOF``
+
+Usage:
+    python tools/lint_metrics.py            # live-daemon gate (check.sh)
+    python tools/lint_metrics.py --file X   # lint a saved exposition
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_FAMILY_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# a sample line: name{labels} value [# {exemplar-labels} value [ts]]
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>\S+)"
+    r"(?P<exemplar> # \{[^}]*\} \S+(?: \S+)?)?$"
+)
+_ESCAPE_RE = re.compile(r"\\(.)")
+_LEGAL_ESCAPES = {"\\", '"', "n"}
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_labels(raw: str):
+    """'a="x",b="y"' -> dict, or a string error."""
+    labels = {}
+    rest = raw
+    while rest:
+        m = re.match(r'([a-zA-Z_][a-zA-Z0-9_]*)="', rest)
+        if m is None:
+            return f"malformed label segment {rest!r}"
+        name = m.group(1)
+        i = m.end()
+        value_chars = []
+        while i < len(rest):
+            c = rest[i]
+            if c == "\\":
+                if i + 1 >= len(rest):
+                    return f"dangling escape in label {name}"
+                esc = rest[i + 1]
+                if esc not in _LEGAL_ESCAPES:
+                    return f"illegal escape \\{esc} in label {name}"
+                value_chars.append(c + esc)
+                i += 2
+                continue
+            if c == '"':
+                break
+            value_chars.append(c)
+            i += 1
+        else:
+            return f"unterminated label value for {name}"
+        if name in labels:
+            return f"duplicate label name {name}"
+        labels[name] = "".join(value_chars)
+        rest = rest[i + 1:]
+        if rest.startswith(","):
+            rest = rest[1:]
+        elif rest:
+            return f"expected ',' between labels, got {rest!r}"
+    return labels
+
+
+def _family_of(sample_name: str, families: dict) -> str | None:
+    """Longest declared family this sample name could belong to."""
+    if sample_name in families:
+        return sample_name
+    for suffix in _HIST_SUFFIXES:
+        if sample_name.endswith(suffix) and sample_name[: -len(suffix)] in families:
+            return sample_name[: -len(suffix)]
+    return None
+
+
+def _le_sort_key(le: str) -> float:
+    if le == "+Inf":
+        return float("inf")
+    try:
+        return float(le)
+    except ValueError:
+        return float("nan")
+
+
+def lint_text(text: str, openmetrics: bool = False) -> list[str]:
+    """Return a list of human-readable violations (empty = clean)."""
+    violations: list[str] = []
+    families: dict[str, dict] = {}  # name -> {help, type, samples}
+    seen_series: set[tuple] = set()
+    # family -> {label-key-without-le: [(le, count)]}
+    buckets: dict[str, dict[tuple, list]] = {}
+    counts: dict[str, dict[tuple, float]] = {}
+    lines = text.split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()
+    saw_eof = False
+    for lineno, line in enumerate(lines, start=1):
+        if saw_eof:
+            violations.append(f"line {lineno}: content after # EOF")
+            break
+        if line == "# EOF":
+            if not openmetrics:
+                violations.append(
+                    f"line {lineno}: # EOF in a non-OpenMetrics exposition"
+                )
+            saw_eof = True
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            kind = line[2:6]
+            rest = line[7:]
+            parts = rest.split(" ", 1)
+            name = parts[0]
+            payload = parts[1] if len(parts) > 1 else ""
+            if not _FAMILY_RE.match(name):
+                violations.append(
+                    f"line {lineno}: family name {name!r} violates "
+                    "lowercase snake_case convention"
+                )
+            fam = families.setdefault(
+                name, {"help": None, "type": None, "samples": 0}
+            )
+            if kind == "HELP":
+                if fam["help"] is not None:
+                    violations.append(
+                        f"line {lineno}: duplicate # HELP for {name}"
+                    )
+                fam["help"] = payload
+            else:
+                if fam["type"] is not None:
+                    violations.append(
+                        f"line {lineno}: duplicate # TYPE for {name}"
+                    )
+                if payload not in ("counter", "gauge", "histogram", "summary"):
+                    violations.append(
+                        f"line {lineno}: unknown TYPE {payload!r} for {name}"
+                    )
+                if fam["samples"]:
+                    violations.append(
+                        f"line {lineno}: # TYPE for {name} after its samples"
+                    )
+                fam["type"] = payload
+            continue
+        if line.startswith("#"):
+            continue  # free-form comment
+        if not line.strip():
+            violations.append(f"line {lineno}: blank line in exposition")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            violations.append(f"line {lineno}: unparseable sample {line!r}")
+            continue
+        name = m.group("name")
+        raw_labels = m.group("labels")
+        labels = _parse_labels(raw_labels) if raw_labels else {}
+        if isinstance(labels, str):
+            violations.append(f"line {lineno}: {labels}")
+            continue
+        for ln in labels:
+            if not _LABEL_NAME_RE.match(ln):
+                violations.append(
+                    f"line {lineno}: illegal label name {ln!r}"
+                )
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            violations.append(
+                f"line {lineno}: non-numeric value {m.group('value')!r}"
+            )
+            continue
+        if m.group("exemplar"):
+            if not openmetrics:
+                violations.append(
+                    f"line {lineno}: exemplar in a non-OpenMetrics exposition"
+                )
+            elif not name.endswith("_bucket"):
+                violations.append(
+                    f"line {lineno}: exemplar on non-bucket sample {name}"
+                )
+        fam_name = _family_of(name, families)
+        if fam_name is None:
+            violations.append(
+                f"line {lineno}: sample {name} has no preceding "
+                "# HELP/# TYPE family declaration"
+            )
+            continue
+        fam = families[fam_name]
+        fam["samples"] += 1
+        if fam["help"] is None:
+            violations.append(f"line {lineno}: {fam_name} missing # HELP")
+        if fam["type"] is None:
+            violations.append(f"line {lineno}: {fam_name} missing # TYPE")
+        ftype = fam["type"]
+        if ftype == "counter":
+            if not fam_name.endswith("_total"):
+                violations.append(
+                    f"counter family {fam_name} does not end in _total"
+                )
+            if name != fam_name:
+                violations.append(
+                    f"line {lineno}: counter sample {name} != family "
+                    f"{fam_name}"
+                )
+            if value < 0:
+                violations.append(
+                    f"line {lineno}: negative counter {name} = {value}"
+                )
+        elif ftype == "gauge":
+            if name != fam_name:
+                violations.append(
+                    f"line {lineno}: gauge sample {name} != family {fam_name}"
+                )
+        elif ftype == "histogram":
+            suffix = name[len(fam_name):]
+            if suffix not in _HIST_SUFFIXES:
+                violations.append(
+                    f"line {lineno}: histogram sample suffix {suffix!r} "
+                    f"on {fam_name}"
+                )
+            if suffix == "_bucket":
+                if "le" not in labels:
+                    violations.append(
+                        f"line {lineno}: _bucket sample without le label"
+                    )
+                else:
+                    key = tuple(
+                        sorted(
+                            (k, v) for k, v in labels.items() if k != "le"
+                        )
+                    )
+                    buckets.setdefault(fam_name, {}).setdefault(
+                        key, []
+                    ).append((labels["le"], value))
+            elif suffix == "_count":
+                key = tuple(sorted(labels.items()))
+                counts.setdefault(fam_name, {})[key] = value
+        series_key = (name, tuple(sorted(labels.items())))
+        if series_key in seen_series:
+            violations.append(
+                f"line {lineno}: duplicate series {name}"
+                f"{dict(sorted(labels.items()))}"
+            )
+        seen_series.add(series_key)
+    if openmetrics and not saw_eof:
+        violations.append("OpenMetrics exposition missing trailing # EOF")
+    # NOTE: a family with # HELP/# TYPE and zero samples is legal — labeled
+    # metrics expose headers before their first child is created.
+    # bucket monotonicity + +Inf/_count agreement
+    for fam_name, by_series in buckets.items():
+        for key, pairs in by_series.items():
+            ordered = sorted(pairs, key=lambda p: _le_sort_key(p[0]))
+            les = [p[0] for p in ordered]
+            vals = [p[1] for p in ordered]
+            if any(v != v for v in (_le_sort_key(le) for le in les)):
+                violations.append(
+                    f"{fam_name}{dict(key)}: unparseable le value in {les}"
+                )
+                continue
+            if "+Inf" not in les:
+                violations.append(
+                    f"{fam_name}{dict(key)}: no +Inf bucket"
+                )
+            if any(b < a for a, b in zip(vals, vals[1:])):
+                violations.append(
+                    f"{fam_name}{dict(key)}: bucket counts not cumulative "
+                    f"({vals})"
+                )
+            cnt = counts.get(fam_name, {}).get(key)
+            if cnt is not None and les and les[-1] == "+Inf" and vals[-1] != cnt:
+                violations.append(
+                    f"{fam_name}{dict(key)}: +Inf bucket {vals[-1]} != "
+                    f"_count {cnt}"
+                )
+    return violations
+
+
+# -- live-daemon gate ---------------------------------------------------------
+
+
+def _scrape(port: int, openmetrics: bool) -> str:
+    import urllib.request
+
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/metrics")
+    if openmetrics:
+        req.add_header("Accept", "application/openmetrics-text")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        ctype = resp.headers.get("Content-Type", "")
+        body = resp.read().decode("utf-8")
+    if openmetrics and "application/openmetrics-text" not in ctype:
+        raise RuntimeError(
+            f"OpenMetrics scrape answered Content-Type {ctype!r}"
+        )
+    return body
+
+
+def _run_live_gate() -> list[str]:
+    """Boot the serving stack, drive both transports, lint every
+    plane/format combination."""
+    import asyncio
+    import threading
+    import urllib.request
+
+    from keto_tpu.driver.config import Config
+    from keto_tpu.driver.registry import Registry
+
+    cfg = Config(
+        values={
+            "namespaces": [{"id": 1, "name": "lintns"}],
+            "serve": {
+                "read": {"port": 0, "host": "127.0.0.1"},
+                "write": {"port": 0, "host": "127.0.0.1"},
+            },
+            "log": {"level": "error", "format": "json"},
+            "tracing": {"provider": ""},
+        },
+        env={},
+    )
+    registry = Registry(cfg)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    read_port, write_port = asyncio.run_coroutine_threadsafe(
+        registry.start_all(), loop
+    ).result(timeout=180)
+    violations: list[str] = []
+    try:
+        # traffic: a write, an allowed check, a denied check, a batch —
+        # populates the request/check/pipeline series on both planes
+        body = json.dumps(
+            {
+                "namespace": "lintns",
+                "object": "doc",
+                "relation": "view",
+                "subject_id": "alice",
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{write_port}/relation-tuples",
+            data=body,
+            method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+        for subject in ("alice", "mallory"):
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{read_port}/check?namespace=lintns"
+                    f"&object=doc&relation=view&subject_id={subject}",
+                    timeout=10,
+                ).read()
+            except urllib.error.HTTPError as e:
+                if e.code != 403:
+                    raise
+        batch = json.dumps(
+            {
+                "tuples": [
+                    {
+                        "namespace": "lintns",
+                        "object": "doc",
+                        "relation": "view",
+                        "subject_id": "alice",
+                    }
+                ]
+            }
+        ).encode()
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{read_port}/check/batch",
+                data=batch,
+                headers={"Content-Type": "application/json"},
+            ),
+            timeout=10,
+        ).read()
+        for plane, port in (("read", read_port), ("write", write_port)):
+            for om in (False, True):
+                label = f"{plane}/{'openmetrics' if om else 'text'}"
+                try:
+                    text = _scrape(port, om)
+                except Exception as e:
+                    violations.append(f"{label}: scrape failed: {e}")
+                    continue
+                violations.extend(
+                    f"{label}: {v}" for v in lint_text(text, openmetrics=om)
+                )
+    finally:
+        asyncio.run_coroutine_threadsafe(
+            registry.stop_all(), loop
+        ).result(timeout=60)
+        loop.call_soon_threadsafe(loop.stop)
+    return violations
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--file", default=None,
+        help="lint a saved exposition instead of booting a live daemon",
+    )
+    ap.add_argument(
+        "--openmetrics", action="store_true",
+        help="treat --file input as OpenMetrics (exemplars + # EOF)",
+    )
+    args = ap.parse_args()
+    if args.file:
+        with open(args.file) as f:
+            violations = lint_text(f.read(), openmetrics=args.openmetrics)
+    else:
+        violations = _run_live_gate()
+    if violations:
+        for v in violations:
+            print(f"VIOLATION: {v}", file=sys.stderr)
+        print(
+            json.dumps({"metrics_lint": "fail", "violations": len(violations)})
+        )
+        return 1
+    print(json.dumps({"metrics_lint": "ok"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
