@@ -1284,6 +1284,24 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
     except Exception:
         tail_culls = {}
 
+    # Accounting-ledger snapshot: the server's own answer to "where did
+    # the wall time of every check above go", read over the live /debug
+    # surface (same endpoint operators use) before teardown wipes it.
+    attribution = None
+    try:
+        import httpx
+
+        attribution = (
+            httpx.get(
+                f"http://127.0.0.1:{http_direct}/debug/attribution",
+                timeout=10,
+            )
+            .json()
+            .get("attribution")
+        )
+    except Exception as e:
+        print(f"[attribution fetch failed: {e}]", file=sys.stderr)
+
     asyncio.run_coroutine_threadsafe(reg.stop_all(), loop).result(timeout=30)
     loop.call_soon_threadsafe(loop.stop)
     loop_thread.join(timeout=10)
@@ -1362,6 +1380,24 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
         "tail_p999_ms": round(1000 * float(np.percentile(tail_lat, 99.9)), 2),
         "tail_deadline_miss_rate": round(tail_misses / max(1, tail_n), 4),
         "tail_server_culls": tail_culls,
+        # serving_overhead, decomposed: per-stage share of measured check
+        # wall time from the accounting ledger, plus how much of the wall
+        # the marks actually covered (the --smoke gate asserts >= 0.95)
+        "serving_overhead_breakdown": (
+            None
+            if not attribution
+            else {
+                "coverage": attribution.get("coverage"),
+                "requests": attribution.get("requests"),
+                "wall_s": attribution.get("wall_s"),
+                "stage_share_of_wall": {
+                    stage: info.get("share_of_wall")
+                    for stage, info in (
+                        attribution.get("stages") or {}
+                    ).items()
+                },
+            }
+        ),
     }
     return out
 
@@ -1598,27 +1634,56 @@ def _probe_backend(timeout_s: float) -> tuple[str | None, str | None]:
     HANGS (not raises) on a sick tunneled chip, so an in-process
     ``jax.devices()`` can wedge the whole bench with no output (VERDICT r4:
     BENCH_r04 was rc=1/parsed=null for exactly this). Returns
-    (platform, None) on success, (None, error) on failure/timeout."""
+    (platform, None) on success, (None, error) on failure/timeout.
+
+    The child runs in its OWN process group and timeout means SIGKILL to
+    that whole group (BENCH_r05: the probe "hung >180s" because
+    subprocess.run's post-timeout cleanup kills only the direct child and
+    then calls communicate() with no timeout — TPU-runtime grandchildren
+    inherit the pipe write ends, never deliver EOF, and the bench wedges
+    on its own watchdog path). The pipes are drained non-blockingly after
+    the kill for the same reason."""
+    import signal
     import subprocess
 
     code = "import jax; print(jax.devices()[0].platform)"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        env=dict(os.environ),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            env=dict(os.environ),
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
+        stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return None, f"jax.devices() hung >{timeout_s:.0f}s (backend probe)"
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        for stream in (proc.stdout, proc.stderr):
+            try:
+                os.set_blocking(stream.fileno(), False)
+                stream.read()
+                stream.close()
+            except Exception:
+                pass
+        return None, (
+            f"jax.devices() hung >{timeout_s:.0f}s "
+            "(backend probe; process group killed)"
+        )
     if proc.returncode != 0:
         return None, f"backend init failed rc={proc.returncode}: " + (
-            proc.stderr.strip().splitlines()[-1][-300:]
-            if proc.stderr.strip()
+            stderr.strip().splitlines()[-1][-300:]
+            if stderr.strip()
             else "no stderr"
         )
-    return proc.stdout.strip() or "unknown", None
+    return stdout.strip() or "unknown", None
 
 
 def main():
@@ -1771,6 +1836,32 @@ def main():
         sys.exit(1)
     _print_primary(results, backend_meta)
 
+    if "--smoke" in sys.argv:
+        # overhead regression gate: the accounting ledger must explain the
+        # serving wall time it measured — more than 5% unattributed means
+        # some stage lost its marks (a leak a refactor can silently
+        # introduce), and a missing breakdown after a server leg ran means
+        # /debug/attribution itself broke.
+        for r in results:
+            if "serving_overhead_breakdown" not in r:
+                continue  # server leg skipped (budget) — nothing to gate
+            bd = r["serving_overhead_breakdown"]
+            cov = (bd or {}).get("coverage")
+            if bd is None or cov is None or cov < 0.95:
+                print(
+                    json.dumps(
+                        {
+                            "gate": "attribution_leak",
+                            "config": r.get("config"),
+                            "coverage": cov,
+                            "required": 0.95,
+                        }
+                    ),
+                    file=sys.stderr,
+                    flush=True,
+                )
+                sys.exit(3)
+
 
 def _print_primary(results, backend_meta=None):
     primary = max(results, key=lambda r: r["tuples"])
@@ -1810,6 +1901,11 @@ def _print_primary(results, backend_meta=None):
         "grpc_batch_columnar_rps": primary.get("grpc_batch_columnar_rps"),
         "grpc_zipf_rps": primary.get("grpc_zipf_rps"),
         "serving_overhead": serving_overhead,
+        # the accounting ledger's decomposition of that overhead into
+        # named per-stage costs (share of measured check wall time)
+        "serving_overhead_breakdown": primary.get(
+            "serving_overhead_breakdown"
+        ),
         "batch_rps": primary.get("batch_rps"),
         "query_mode": primary.get("query_mode"),
         "device_check_rps": primary.get("device_check_rps"),

@@ -8,6 +8,13 @@ Routes (all under /debug, read port only):
 - ``/debug/traces``   the tracer's finished-span ring (hex ids)
 - ``/debug/config``   effective config with secret redaction
 - ``/debug/profile``  ?seconds=N jax.profiler capture, returned as .tar.gz
+- ``/debug/attribution``  where check wall time goes: the accounting
+  ledger's per-stage breakdown (seconds + share of wall + coverage),
+  plus the last closure-build phase timings
+- ``/debug/pprof``    the stdlib sampling profiler: ?format=folded for
+  classic folded stacks (feed to tools/flame.py), default JSON
+  flamegraph tree + profiler stats; ?seconds=N runs an on-demand
+  capture when the profiler is not already running
 
 Gating: ``debug.enabled: false`` hides the whole surface as 404 (the
 routes do not exist as far as a prober can tell); ``debug.token`` set
@@ -96,6 +103,9 @@ class DebugContext:
         enabled: bool = True,
         token: str = "",
         profile_max_s: float = 30.0,
+        attribution=None,
+        profiler=None,
+        build_phases_fn=None,
     ):
         self.config = config
         self.flight = flight
@@ -107,6 +117,12 @@ class DebugContext:
         self.enabled = bool(enabled)
         self.token = token or ""
         self.profile_max_s = float(profile_max_s)
+        # PR7 performance-attribution plane: the wall-clock accounting
+        # ledger aggregate, the stdlib sampling profiler, and a zero-arg
+        # callable yielding the engine's last closure-build phase timings
+        self.attribution = attribution
+        self.profiler = profiler
+        self.build_phases_fn = build_phases_fn
 
 
 class DebugAPI:
@@ -121,6 +137,8 @@ class DebugAPI:
         app.router.add_get("/debug/traces", self.get_traces)
         app.router.add_get("/debug/config", self.get_config)
         app.router.add_get("/debug/profile", self.get_profile)
+        app.router.add_get("/debug/attribution", self.get_attribution)
+        app.router.add_get("/debug/pprof", self.get_pprof)
 
     # -- gate -----------------------------------------------------------------
 
@@ -207,6 +225,68 @@ class DebugAPI:
             )
             payload["config_file"] = getattr(cfg, "config_file", None)
         return web.json_response(payload, dumps=_dumps)
+
+    async def get_attribution(self, request: web.Request) -> web.Response:
+        """Where the serving time went: the accounting ledger's stage
+        breakdown (the direct decomposition of `serving_overhead` into
+        named costs) plus the engine's last closure-build phases."""
+        self._gate(request)
+        attribution = self.ctx.attribution
+        payload = {
+            "attribution": (
+                attribution.snapshot() if attribution is not None else None
+            ),
+        }
+        if self.ctx.build_phases_fn is not None:
+            try:
+                payload["closure_build_phases"] = dict(
+                    self.ctx.build_phases_fn() or {}
+                )
+            except Exception:
+                payload["closure_build_phases"] = None
+        return web.json_response(payload, dumps=_dumps)
+
+    async def get_pprof(self, request: web.Request) -> web.Response:
+        """The stdlib sampling profiler's view of the process.
+
+        ``?format=folded`` returns classic folded stacks (one
+        ``stack count`` line each — pipe into tools/flame.py);
+        the default is a flamegraph-ready JSON tree plus profiler
+        stats. ``?seconds=N`` runs a bounded on-demand capture when
+        the profiler is not already running continuously."""
+        self._gate(request)
+        prof = self.ctx.profiler
+        if prof is None:
+            return web.json_response(
+                {"error": "sampling profiler not wired"}, status=503
+            )
+        seconds_q = request.rel_url.query.get("seconds")
+        if seconds_q is not None and not prof.running:
+            try:
+                seconds = float(seconds_q)
+            except ValueError:
+                seconds = 1.0
+            seconds = max(0.1, min(seconds, self.ctx.profile_max_s))
+            if not self._profile_lock.acquire(blocking=False):
+                return web.json_response(
+                    {"error": "a profile capture is already running"},
+                    status=409,
+                )
+            try:
+                prof.reset()
+                prof.start()
+                await asyncio.sleep(seconds)
+                prof.stop()
+            finally:
+                self._profile_lock.release()
+        if request.rel_url.query.get("format") == "folded":
+            return web.Response(
+                text=prof.folded_text(), content_type="text/plain"
+            )
+        return web.json_response(
+            {"profiler": prof.snapshot(), "tree": prof.tree()},
+            dumps=_dumps,
+        )
 
     async def get_profile(self, request: web.Request) -> web.Response:
         self._gate(request)

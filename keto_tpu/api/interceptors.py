@@ -21,6 +21,7 @@ import time
 
 import grpc
 
+from ..telemetry.tracing import TRACEPARENT_HEADER, parse_traceparent
 from ..utils.errors import KetoError
 
 
@@ -67,12 +68,20 @@ class TelemetryInterceptor(grpc.ServerInterceptor):
             return handler
         method = handler_call_details.method
         inner = handler.unary_unary
+        # W3C trace propagation: a client-minted traceparent on the
+        # invocation metadata becomes the remote parent of the grpc.request
+        # span, so the whole server-side span tree joins the caller's trace
+        remote = None
+        for key, value in handler_call_details.invocation_metadata or ():
+            if key == TRACEPARENT_HEADER:
+                remote = parse_traceparent(value)
+                break
 
         def wrapped(request, context):
             t0 = time.perf_counter()
             code = "OK"
             span = (
-                self.tracer.span("grpc.request", method=method)
+                self.tracer.span("grpc.request", method=method, parent=remote)
                 if self.tracer is not None
                 else None
             )

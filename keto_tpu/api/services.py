@@ -26,6 +26,7 @@ import grpc
 from ..faults import FAULTS
 from ..relationtuple.columns import CheckColumns, proto_has_columns
 from ..telemetry.flight import NOOP_CHECK_TELEMETRY
+from ..telemetry.tracing import HEDGE_HEADER, TRACEPARENT_HEADER
 from ..relationtuple.definitions import RelationQuery, RelationTuple
 from ..utils.errors import DeadlineExceeded, ErrMalformedInput, KetoError
 from ..utils.pagination import PaginationOptions
@@ -48,6 +49,27 @@ from .convert import (
 )
 
 _PKG = "ory.keto.acl.v1alpha1"
+
+
+def _trace_from_metadata(context) -> tuple:
+    """(traceparent, hedge) carried on gRPC invocation metadata.
+
+    The client injects a W3C ``traceparent`` entry per call (hedged
+    duplicates add ``x-keto-hedge: 1``) so server-side spans, flight
+    records, and exemplars join the caller's trace. Metadata keys arrive
+    lowercased per the gRPC spec."""
+    traceparent = None
+    hedge = False
+    try:
+        metadata = context.invocation_metadata() or ()
+    except Exception:
+        return None, False
+    for key, value in metadata:
+        if key == TRACEPARENT_HEADER:
+            traceparent = value
+        elif key == HEDGE_HEADER:
+            hedge = value == "1"
+    return traceparent, hedge
 
 
 def _abort(context: grpc.ServicerContext, err: Exception):
@@ -143,10 +165,15 @@ class CheckServicer:
             context.add_callback(
                 lambda: [f.cancel() for f in entries]
             )
+            traceparent, hedge = _trace_from_metadata(context)
+            # response built INSIDE the record so proto construction is
+            # charged to the ledger's 'serialize' stage (and 'reply'
+            # covers only the record-exit bookkeeping)
             with self.telemetry.record_check(
                 "grpc", deadline=deadline,
                 detail={"namespace": request.namespace},
-            ):
+                traceparent=traceparent, hedge=hedge,
+            ) as rec:
                 allowed = self.checker.check(
                     tup,
                     request.max_depth,
@@ -155,9 +182,11 @@ class CheckServicer:
                     deadline=deadline,
                     entry_hook=entries.append,
                 )
-            return check_service_pb2.CheckResponse(
-                allowed=allowed, snaptoken=self.snaptoken_fn()
-            )
+                resp = check_service_pb2.CheckResponse(
+                    allowed=allowed, snaptoken=self.snaptoken_fn()
+                )
+                rec.mark("serialize")
+            return resp
         except Exception as e:
             _abort(context, e)
 
@@ -174,12 +203,14 @@ class CheckServicer:
                 None if remaining is None else time.monotonic() + remaining
             )
             min_version = min_version_from(request.snaptoken, request.latest)
+            traceparent, hedge = _trace_from_metadata(context)
             if proto_has_columns(request):
                 cols = CheckColumns.from_proto(request)
                 run = getattr(self.checker, "check_batch_columnar", None)
                 with self.telemetry.record_check(
-                    "grpc_batch", batch_size=len(cols), deadline=deadline
-                ):
+                    "grpc_batch", batch_size=len(cols), deadline=deadline,
+                    traceparent=traceparent, hedge=hedge,
+                ) as rec:
                     if run is not None:
                         allowed = run(
                             cols,
@@ -194,9 +225,11 @@ class CheckServicer:
                             min_version=min_version,
                             timeout=timeout,
                         )
-                return check_service_pb2.BatchCheckResponse(
-                    allowed=allowed, snaptoken=self.snaptoken_fn()
-                )
+                    resp = check_service_pb2.BatchCheckResponse(
+                        allowed=allowed, snaptoken=self.snaptoken_fn()
+                    )
+                    rec.mark("serialize")
+                return resp
             tuples = []
             for item in request.tuples:
                 subject = subject_from_proto(
@@ -215,8 +248,9 @@ class CheckServicer:
                     )
                 )
             with self.telemetry.record_check(
-                "grpc_batch", batch_size=len(tuples), deadline=deadline
-            ):
+                "grpc_batch", batch_size=len(tuples), deadline=deadline,
+                traceparent=traceparent, hedge=hedge,
+            ) as rec:
                 allowed = self.checker.check_batch(
                     tuples,
                     request.max_depth,
@@ -224,9 +258,11 @@ class CheckServicer:
                     timeout=timeout,
                     deadline=deadline,
                 )
-            return check_service_pb2.BatchCheckResponse(
-                allowed=allowed, snaptoken=self.snaptoken_fn()
-            )
+                resp = check_service_pb2.BatchCheckResponse(
+                    allowed=allowed, snaptoken=self.snaptoken_fn()
+                )
+                rec.mark("serialize")
+            return resp
         except Exception as e:
             _abort(context, e)
 

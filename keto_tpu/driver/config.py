@@ -292,6 +292,33 @@ CONFIG_SCHEMA = {
                     },
                     "additionalProperties": False,
                 },
+                # wall-clock accounting ledger (telemetry/attribution.py):
+                # per-stage time attribution behind /debug/attribution and
+                # keto_time_attribution_seconds_total
+                "attribution": {
+                    "type": "object",
+                    "properties": {
+                        "enabled": {"type": "boolean"},
+                    },
+                    "additionalProperties": False,
+                },
+                # stdlib sampling profiler (telemetry/profiler.py) behind
+                # /debug/pprof; enabled=true samples continuously from
+                # registry bring-up, else /debug/pprof?seconds=N captures
+                # on demand
+                "profiler": {
+                    "type": "object",
+                    "properties": {
+                        "enabled": {"type": "boolean"},
+                        "hz": {
+                            "type": "number",
+                            "exclusiveMinimum": 0,
+                            "maximum": 1000,
+                        },
+                        "max_stacks": {"type": "integer", "minimum": 1},
+                    },
+                    "additionalProperties": False,
+                },
             },
             "additionalProperties": False,
         },
@@ -365,6 +392,12 @@ DEFAULTS = {
     "telemetry.slo.slow_window_s": 3600,
     "telemetry.slo.alert_burn_rate": 2.0,
     "telemetry.slo.alert_cooldown_s": 300,
+    "telemetry.attribution.enabled": True,
+    "telemetry.profiler.enabled": False,
+    # 67 Hz: off-round so sampling never phase-locks with 10ms-periodic
+    # work (batch windows, flush timers) and under-counts it
+    "telemetry.profiler.hz": 67.0,
+    "telemetry.profiler.max_stacks": 10000,
     "debug.enabled": True,
     "debug.token": "",
     "debug.profile_max_s": 30,

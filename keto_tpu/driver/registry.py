@@ -59,6 +59,8 @@ class Registry:
         self._slo = None
         self._check_telemetry = None
         self._debug_context = None
+        self._attribution = None
+        self._profiler = None
         self._config_watcher: Optional[threading.Thread] = None
         self._config_watch_stop = threading.Event()
 
@@ -241,9 +243,47 @@ class Registry:
             )
         return self._slo
 
+    def attribution(self):
+        """The wall-clock accounting ledger aggregate: every finished
+        check folds its per-stage ledger in here, feeding
+        keto_time_attribution_seconds_total and /debug/attribution."""
+        if self._attribution is None:
+            from ..telemetry.attribution import AttributionLedger
+
+            enabled = bool(
+                self.config.get(
+                    "telemetry.attribution.enabled", default=True
+                )
+            )
+            self._attribution = AttributionLedger(
+                metrics=self.metrics() if enabled else None
+            )
+        return self._attribution
+
+    def profiler(self):
+        """The stdlib sampling profiler behind /debug/pprof. Constructed
+        lazily; its thread is started in start_all (AFTER any replica
+        fork — a sampler thread at fork time would trip fork hygiene)
+        and only when telemetry.profiler.enabled."""
+        if self._profiler is None:
+            from ..telemetry.profiler import SamplingProfiler
+
+            self._profiler = SamplingProfiler(
+                hz=float(
+                    self.config.get("telemetry.profiler.hz", default=67.0)
+                ),
+                max_stacks=int(
+                    self.config.get(
+                        "telemetry.profiler.max_stacks", default=10000
+                    )
+                ),
+            )
+        return self._profiler
+
     def check_telemetry(self):
-        """The per-request seam (span + exemplar + SLO + flight) handed to
-        the REST ReadAPI and the gRPC CheckServicer."""
+        """The per-request seam (span + exemplar + SLO + flight +
+        attribution ledger) handed to the REST ReadAPI and the gRPC
+        CheckServicer."""
         if self._check_telemetry is None:
             from ..telemetry import CheckTelemetry
 
@@ -257,6 +297,7 @@ class Registry:
                 )
                 / 1e3,
                 stages_fn=self._stage_percentiles,
+                attribution=self.attribution(),
             )
         return self._check_telemetry
 
@@ -300,8 +341,18 @@ class Registry:
                 profile_max_s=float(
                     self.config.get("debug.profile_max_s", default=30)
                 ),
+                attribution=self.attribution(),
+                profiler=self.profiler(),
+                build_phases_fn=self._build_phases,
             )
         return self._debug_context
+
+    def _build_phases(self):
+        """Last closure-build phase timings, when the engine records them
+        (engine/closure.py last_build_phases) — /debug/attribution's view
+        of where the big one-off cost (the 500s-class closure build) went."""
+        engine = self._check_engine
+        return getattr(engine, "last_build_phases", None)
 
     # -- providers (lazy, like RegistryDefault's memoized getters) ------------
 
@@ -579,6 +630,7 @@ class Registry:
                         )
                     ),
                     max_freshness_wait_s=self._freshness_cap_s,
+                    tracer=self.tracer(),
                 )
                 self._checker = self._batcher
         return self._checker
@@ -910,6 +962,12 @@ class Registry:
         read_port = await self.read_plane().start()
         write_port = await self.write_plane().start()
         self._start_config_watcher()
+        if bool(
+            self.config.get("telemetry.profiler.enabled", default=False)
+        ):
+            # continuous sampling profiler: started only here — after any
+            # replica fork — so its thread never violates fork hygiene
+            self.profiler().start()
         self.health.set_serving(True)  # readiness flips only after bring-up
         log.info(
             "serving",
@@ -1121,6 +1179,9 @@ class Registry:
             # hang-not-raise mode), same reasoning as PlaneServer.stop
             self._check_executor.shutdown(wait=False, cancel_futures=True)
             self._check_executor = None
+        if self._profiler is not None:
+            self._profiler.stop()
+            self._profiler = None
         if self._flight is not None:
             # final ring flush + faulthandler disarm
             self._flight.close()

@@ -56,11 +56,13 @@ from typing import Optional, Sequence
 
 from ..faults import FAULTS
 from ..relationtuple.definitions import RelationTuple
+from ..telemetry.attribution import current_ledger, ledger_mark
 from ..telemetry.devstats import DEVSTATS
 from ..telemetry.metrics import (
     deadline_expired_counter,
     pipeline_stage_histogram,
 )
+from ..telemetry.tracing import _current_span
 from ..utils.errors import (
     DeadlineExceeded,
     ErrInternal,
@@ -103,7 +105,8 @@ class _PBatch:
     __slots__ = ("items", "enc", "launched", "keys", "t_encoded")
 
     def __init__(self, items):
-        # [(request, depth, Future, t_enqueued, deadline), ...]
+        # [(request, depth, Future, t_enqueued, deadline, ledger,
+        #   span_ctx), ...]
         self.items = items
         self.enc = None  # EncodedBatch after the encode stage
         self.launched = None  # LaunchedBatch after the launch stage
@@ -140,8 +143,10 @@ class CheckBatcher:
         # snaptoken catch-up cap: float, or a zero-arg callable for a
         # hot-reloadable knob (serve.read.max_freshness_wait_s)
         max_freshness_wait_s=30.0,
+        tracer=None,  # stage spans join the caller's trace when set
     ):
         self.engine = engine
+        self.tracer = tracer
         self.max_batch = max_batch
         self.window_s = window_s
         self.cache = cache
@@ -217,7 +222,8 @@ class CheckBatcher:
                 self._m_stage = pipeline_stage_histogram(metrics)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._queue: list[tuple] = []  # (request, depth, Future, t_enqueued)
+        # (request, depth, Future, t_enqueued, deadline, ledger, span_ctx)
+        self._queue: list[tuple] = []
         # serial mode: the batch the dispatcher popped but has not answered
         # yet — the watchdog fails exactly these on a dispatcher death, and
         # close() fails them after the join budget
@@ -345,6 +351,15 @@ class CheckBatcher:
             if cached is not None:
                 return cached
         f: Future = Future()
+        # the per-request accounting ledger and span context ride the
+        # queue entry: the pipeline stage threads mark wait/encode/
+        # launch/kernel/decode on the ledger and parent their stage
+        # spans to the caller's trace. Everything up to the enqueue is
+        # "admission" (transport handling, freshness wait, cache probe).
+        led = current_ledger()
+        if led is not None:
+            led.mark("admission")
+        span_ctx = _current_span.get()
         with self._cv:
             if self._closed:
                 raise BatcherClosed()
@@ -357,7 +372,10 @@ class CheckBatcher:
                     self._m_shed.inc()
                 raise BatcherOverloaded()
             self._queue.append(
-                (request, max_depth, f, time.perf_counter(), deadline)
+                (
+                    request, max_depth, f, time.perf_counter(), deadline,
+                    led, span_ctx,
+                )
             )
             self._cv.notify()
         if entry_hook is not None:
@@ -414,26 +432,44 @@ class CheckBatcher:
                 self._note_expired("admission", 1)
                 raise DeadlineExceeded()
         if self.cache is None:
-            return dispatch_batched(
-                self.engine, requests, max_depth, self.max_batch
-            )
+            ledger_mark("admission")
+            res = self._dispatch_direct(requests, max_depth)
+            ledger_mark("kernel")
+            return res
         version = self.version_fn()
         keys = [(r, max_depth) for r in requests]
         cached = self.cache.get_many(version, keys)
         miss_idx = [i for i, v in enumerate(cached) if v is None]
+        # admission covers transport handling, the freshness wait, and
+        # the bulk result-cache probe; the engine has not run yet
+        ledger_mark("admission")
         if not miss_idx:
             return [bool(v) for v in cached]
-        res = dispatch_batched(
-            self.engine,
-            [requests[i] for i in miss_idx],
-            max_depth,
-            self.max_batch,
+        res = self._dispatch_direct(
+            [requests[i] for i in miss_idx], max_depth
         )
+        ledger_mark("kernel")
         self.cache.put_many(version, [keys[i] for i in miss_idx], res)
         out = [None if v is None else bool(v) for v in cached]
         for i, v in zip(miss_idx, res):
             out[i] = bool(v)
+        ledger_mark("decode")
         return out
+
+    def _dispatch_direct(self, requests, max_depth: int) -> list[bool]:
+        """Monolithic engine dispatch for a caller-assembled batch, under
+        a stage span that joins the caller's trace via the ambient
+        contextvar (direct paths run on the transport handler thread)."""
+        if self.tracer is not None:
+            with self.tracer.span(
+                "batcher.dispatch", batch_size=len(requests)
+            ):
+                return dispatch_batched(
+                    self.engine, requests, max_depth, self.max_batch
+                )
+        return dispatch_batched(
+            self.engine, requests, max_depth, self.max_batch
+        )
 
     def check_batch_columnar(
         self,
@@ -468,6 +504,8 @@ class CheckBatcher:
                 )
         if self._m_columnar is not None:
             self._m_columnar.inc()
+        # transport handling + freshness wait up to this point
+        ledger_mark("admission")
         if getattr(self.engine, "encode_columns", None) is None:
             return self._columns_via_engine(cols, max_depth)
         out: list[bool] = []
@@ -481,32 +519,49 @@ class CheckBatcher:
         return out
 
     def _dispatch_columns(self, cols, max_depth: int) -> list[bool]:
+        if self.tracer is not None:
+            with self.tracer.span(
+                "batcher.dispatch", batch_size=len(cols), columnar=1
+            ):
+                return self._dispatch_columns_inner(cols, max_depth)
+        return self._dispatch_columns_inner(cols, max_depth)
+
+    def _dispatch_columns_inner(self, cols, max_depth: int) -> list[bool]:
         """One encoded columnar dispatch: encode into staging, resolve
-        cache hits, launch only the misses."""
+        cache hits, launch only the misses. Runs on the transport
+        handler thread, so ``ledger_mark`` charges each phase to the
+        ambient request ledger (the engine itself marks 'kernel' inside
+        ``decode_launched``)."""
         enc = self.engine.encode_columns(cols, max_depth)
         cache = self.encoded_cache
         if cache is None:
-            return [
-                bool(v)
-                for v in self.engine.decode_launched(
-                    self.engine.launch_encoded(enc)
-                )
+            ledger_mark("encode")
+            launched = self.engine.launch_encoded(enc)
+            ledger_mark("launch")
+            out = [
+                bool(v) for v in self.engine.decode_launched(launched)
             ]
+            ledger_mark("decode")
+            return out
         keys = enc.keys()
         cached = cache.get_many(enc.version, keys)
         miss = [i for i, v in enumerate(cached) if v is None]
+        ledger_mark("encode")
         if not miss:
             enc.release()
             return [bool(v) for v in cached]
         if len(miss) < len(keys):
             enc.compact(miss)
-        res = self.engine.decode_launched(self.engine.launch_encoded(enc))
+        launched = self.engine.launch_encoded(enc)
+        ledger_mark("launch")
+        res = self.engine.decode_launched(launched)
         cache.put_many(
             enc.version, [keys[i] for i in miss], [bool(v) for v in res]
         )
         out = [None if v is None else bool(v) for v in cached]
         for i, v in zip(miss, res):
             out[i] = bool(v)
+        ledger_mark("decode")
         return out
 
     def _columns_via_engine(self, cols, max_depth: int) -> list[bool]:
@@ -515,19 +570,24 @@ class CheckBatcher:
         array path), else materialized tuples — with the result cache
         probed in bulk on flat string row keys, not request objects."""
         if self.cache is None:
-            return self._run_columns(cols, max_depth)
+            res = self._run_columns(cols, max_depth)
+            ledger_mark("kernel")
+            return res
         version = self.version_fn()
         keys = cols.row_keys(max_depth)
         cached = self.cache.get_many(version, keys)
         miss = [i for i, v in enumerate(cached) if v is None]
+        ledger_mark("encode")
         if not miss:
             return [bool(v) for v in cached]
         sub = cols.select(miss) if len(miss) < len(cols) else cols
         res = self._run_columns(sub, max_depth)
+        ledger_mark("kernel")
         self.cache.put_many(version, [keys[i] for i in miss], res)
         out = [None if v is None else bool(v) for v in cached]
         for i, v in zip(miss, res):
             out[i] = bool(v)
+        ledger_mark("decode")
         return out
 
     def _run_columns(self, cols, max_depth: int) -> list[bool]:
@@ -592,6 +652,7 @@ class CheckBatcher:
             d = np.where((want <= 0) | (want > gmax), gmax, want)
         else:
             d = want
+        ledger_mark("admission")
         out: list[bool] = []
         for i in range(0, n, self.max_batch):
             out.extend(
@@ -625,7 +686,9 @@ class CheckBatcher:
             out = [None if v is None else bool(v) for v in cached]
             for i, v in zip(miss, res):
                 out[i] = bool(v)
+            ledger_mark("decode")
             return out
+        ledger_mark("decode")
         return [bool(v) for v in res]
 
     def _run_encoded(self, s, t, d) -> list[bool]:
@@ -636,11 +699,11 @@ class CheckBatcher:
         encode_ids = getattr(self.engine, "encode_ids", None)
         if encode_ids is not None:
             enc = encode_ids(s, t, d)
+            ledger_mark("encode")
+            launched = self.engine.launch_encoded(enc)
+            ledger_mark("launch")
             return [
-                bool(v)
-                for v in self.engine.decode_launched(
-                    self.engine.launch_encoded(enc)
-                )
+                bool(v) for v in self.engine.decode_launched(launched)
             ]
         check_ids = getattr(self.engine, "check_ids", None)
         if check_ids is None:
@@ -746,6 +809,28 @@ class CheckBatcher:
             self._m_stage.labels(stage=stage).observe(seconds)
         DEVSTATS.record_stage(stage, seconds)
 
+    @staticmethod
+    def _batch_parent(items):
+        """Parent context for a stage span: the first queue entry that
+        carries one — a batch span joins one representative caller
+        trace (the batch serves many traces; OTLP has no multi-parent)."""
+        for it in items:
+            if len(it) > 6 and it[6] is not None:
+                return it[6]
+        return None
+
+    @staticmethod
+    def _mark_items(items, stage: str, now: Optional[float] = None) -> None:
+        """Charge ``stage`` on every entry's ledger. Safe cross-thread:
+        each entry's marks are sequential (stage handoffs through the
+        bounded queues give the happens-before), and marks always run
+        BEFORE the entry's future resolves so they never race the
+        caller's serialize/reply marks."""
+        for it in items:
+            led = it[5] if len(it) > 5 else None
+            if led is not None:
+                led.mark(stage, now)
+
     # -- deadline / cancellation culling ---------------------------------------
 
     def _note_expired(self, stage: str, n: int) -> None:
@@ -838,10 +923,26 @@ class CheckBatcher:
                 self._inflight = batch
             if self._m_batch_size is not None:
                 self._m_batch_size.observe(len(batch))
+            self._mark_items(batch, "queue", time.perf_counter())
             requests = [b[0] for b in batch]
             depths = [b[1] for b in batch]
+            span = None
+            if self.tracer is not None:
+                span = self.tracer.span(
+                    "batcher.dispatch",
+                    parent=self._batch_parent(batch),
+                    batch_size=len(batch),
+                )
             try:
-                results = self.engine.batch_check(requests, depths=depths)
+                if span is not None:
+                    with span:
+                        results = self.engine.batch_check(
+                            requests, depths=depths
+                        )
+                else:
+                    results = self.engine.batch_check(
+                        requests, depths=depths
+                    )
             except Exception as e:  # propagate to every caller in the batch
                 for item in batch:
                     f = item[2]
@@ -850,6 +951,10 @@ class CheckBatcher:
                 with self._cv:
                     self._inflight = []
                 continue
+            # the serial engine call is monolithic (encode+kernel+decode
+            # in one); charge it all to 'kernel', marked before the
+            # futures resolve so callers' marks can't race
+            self._mark_items(batch, "kernel")
             for item, allowed in zip(batch, results):
                 f = item[2]
                 if not f.done():
@@ -919,54 +1024,72 @@ class CheckBatcher:
             items, _ = self._cull(items, "encode")
             if not items:
                 continue
-            batch = _PBatch(items)
-            holder.batch = batch
-            self._register(batch)
-            FAULTS.fire("batcher.encode_die")
-            FAULTS.maybe_sleep("batcher.encode_slow")
-            t0 = time.perf_counter()
-            self._observe("enqueue", t0 - min(it[3] for it in items))
-            if self._m_batch_size is not None:
-                self._m_batch_size.observe(len(items))
-            requests = [it[0] for it in items]
-            depths = [it[1] for it in items]
-            try:
-                enc = self.engine.encode_batch(requests, depths=depths)
-            except Exception as e:
-                self._fail_batch(batch, e)
-                holder.batch = None
-                continue
-            batch.enc = enc
-            if self.encoded_cache is not None:
-                # encoded-request cache: rows answered at this snapshot
-                # version resolve here; only the misses ride the kernel
-                keys = enc.keys()
-                cached = self.encoded_cache.get_many(enc.version, keys)
-                miss = [i for i, v in enumerate(cached) if v is None]
-                if len(miss) < len(items):
-                    for i, v in enumerate(cached):
-                        if v is not None:
-                            f = items[i][2]
-                            if not f.done():
-                                f.set_result(bool(v))
-                    if not miss:
-                        enc.release()
-                        self._complete(batch)
-                        holder.batch = None
-                        self._observe("encode", time.perf_counter() - t0)
-                        continue
-                    enc.compact(miss)
-                    batch.items = [items[i] for i in miss]
-                    batch.keys = [keys[i] for i in miss]
-                else:
-                    batch.keys = keys
-            self._observe("encode", time.perf_counter() - t0)
-            batch.t_encoded = time.perf_counter()
-            # ownership passes to the launch queue; bounded put is the
-            # encode stage's backpressure
-            self._set_deadlines(batch.enc, batch.items)
+            if self.tracer is not None:
+                with self.tracer.span(
+                    "batcher.encode",
+                    parent=self._batch_parent(items),
+                    batch_size=len(items),
+                ):
+                    self._encode_step(items, holder)
+            else:
+                self._encode_step(items, holder)
+
+    def _encode_step(self, items: list, holder: _Holder) -> None:
+        batch = _PBatch(items)
+        holder.batch = batch
+        self._register(batch)
+        FAULTS.fire("batcher.encode_die")
+        FAULTS.maybe_sleep("batcher.encode_slow")
+        t0 = time.perf_counter()
+        self._observe("enqueue", t0 - min(it[3] for it in items))
+        self._mark_items(items, "queue", t0)
+        if self._m_batch_size is not None:
+            self._m_batch_size.observe(len(items))
+        requests = [it[0] for it in items]
+        depths = [it[1] for it in items]
+        try:
+            enc = self.engine.encode_batch(requests, depths=depths)
+        except Exception as e:
+            self._fail_batch(batch, e)
             holder.batch = None
-            self._launch_q.put(batch)
+            return
+        batch.enc = enc
+        if self.encoded_cache is not None:
+            # encoded-request cache: rows answered at this snapshot
+            # version resolve here; only the misses ride the kernel
+            keys = enc.keys()
+            cached = self.encoded_cache.get_many(enc.version, keys)
+            miss = [i for i, v in enumerate(cached) if v is None]
+            if len(miss) < len(items):
+                now = time.perf_counter()
+                for i, v in enumerate(cached):
+                    if v is not None:
+                        it = items[i]
+                        led = it[5] if len(it) > 5 else None
+                        if led is not None:
+                            led.mark("encode", now)
+                        f = it[2]
+                        if not f.done():
+                            f.set_result(bool(v))
+                if not miss:
+                    enc.release()
+                    self._complete(batch)
+                    holder.batch = None
+                    self._observe("encode", time.perf_counter() - t0)
+                    return
+                enc.compact(miss)
+                batch.items = [items[i] for i in miss]
+                batch.keys = [keys[i] for i in miss]
+            else:
+                batch.keys = keys
+        self._observe("encode", time.perf_counter() - t0)
+        batch.t_encoded = time.perf_counter()
+        self._mark_items(batch.items, "encode", batch.t_encoded)
+        # ownership passes to the launch queue; bounded put is the
+        # encode stage's backpressure
+        self._set_deadlines(batch.enc, batch.items)
+        holder.batch = None
+        self._launch_q.put(batch)
 
     @staticmethod
     def _set_deadlines(enc, items) -> None:
@@ -985,95 +1108,126 @@ class CheckBatcher:
             if batch is _SENTINEL:
                 self._decode_q.put(_SENTINEL)
                 return
-            holder.batch = batch
-            # the device stage inherits the PR-1 dispatcher fault site:
-            # "the dispatcher" is now the thread that talks to the device
-            FAULTS.fire("batcher.dispatcher_die")
-            FAULTS.maybe_sleep("batcher.launch_slow")
-            # cull rows that died waiting in the launch queue BEFORE the
-            # kernel dispatch: compacting the staged buffers here is the
-            # last chance to not pay device time for them
-            kept, keep_idx = self._cull(batch.items, "launch")
-            if not kept:
-                batch.enc.release()
-                self._complete(batch)
-                holder.batch = None
-                continue
-            if len(kept) < len(batch.items):
-                batch.enc.compact(keep_idx)
-                batch.items = kept
-                if batch.keys is not None:
-                    batch.keys = [batch.keys[i] for i in keep_idx]
-                self._set_deadlines(batch.enc, batch.items)
-            try:
-                batch.launched = self.engine.launch_encoded(batch.enc)
-            except Exception as e:
-                self._fail_batch(batch, e)
-                holder.batch = None
-                continue
-            # launch = queue wait + kernel enqueue (async dispatch: this
-            # does NOT include device execution, which overlaps the next
-            # batch's encode/launch)
-            self._observe("launch", time.perf_counter() - batch.t_encoded)
+            if self.tracer is not None:
+                with self.tracer.span(
+                    "batcher.launch",
+                    parent=self._batch_parent(batch.items),
+                    batch_size=len(batch.items),
+                ):
+                    self._launch_step(batch, holder)
+            else:
+                self._launch_step(batch, holder)
+
+    def _launch_step(self, batch: _PBatch, holder: _Holder) -> None:
+        holder.batch = batch
+        # the device stage inherits the PR-1 dispatcher fault site:
+        # "the dispatcher" is now the thread that talks to the device
+        FAULTS.fire("batcher.dispatcher_die")
+        FAULTS.maybe_sleep("batcher.launch_slow")
+        # cull rows that died waiting in the launch queue BEFORE the
+        # kernel dispatch: compacting the staged buffers here is the
+        # last chance to not pay device time for them
+        kept, keep_idx = self._cull(batch.items, "launch")
+        if not kept:
+            batch.enc.release()
+            self._complete(batch)
             holder.batch = None
-            # bounded put: blocks once pipeline_depth batches await decode,
-            # which is what caps batches in flight on device
-            self._decode_q.put(batch)
+            return
+        if len(kept) < len(batch.items):
+            batch.enc.compact(keep_idx)
+            batch.items = kept
+            if batch.keys is not None:
+                batch.keys = [batch.keys[i] for i in keep_idx]
+            self._set_deadlines(batch.enc, batch.items)
+        try:
+            batch.launched = self.engine.launch_encoded(batch.enc)
+        except Exception as e:
+            self._fail_batch(batch, e)
+            holder.batch = None
+            return
+        # launch = queue wait + kernel enqueue (async dispatch: this
+        # does NOT include device execution, which overlaps the next
+        # batch's encode/launch)
+        self._observe("launch", time.perf_counter() - batch.t_encoded)
+        self._mark_items(batch.items, "launch")
+        holder.batch = None
+        # bounded put: blocks once pipeline_depth batches await decode,
+        # which is what caps batches in flight on device
+        self._decode_q.put(batch)
 
     def _decode_loop(self, holder: _Holder) -> None:
         while True:
             batch = self._decode_q.get()
             if batch is _SENTINEL:
                 return
-            holder.batch = batch
-            FAULTS.fire("batcher.decode_die")
-            FAULTS.maybe_sleep("batcher.decode_slow")
-            # rows that died on device still decode (the kernel already
-            # ran; materializing frees the staging buffers) but their
-            # callers are failed typed NOW instead of after the blocking
-            # materialization — items stay in place so results align
-            now = time.monotonic()
-            n_expired = 0
-            for item in batch.items:
-                f = item[2]
-                dl = item[4]
-                if dl is not None and now >= dl and not f.done():
-                    f.set_exception(DeadlineExceeded())
-                    n_expired += 1
-            if n_expired:
-                self._note_expired("decode", n_expired)
-            t0 = time.perf_counter()
-            try:
-                results = self.engine.decode_launched(batch.launched)
-            except Exception as e:
-                self._fail_batch(batch, e)
-                holder.batch = None
-                continue
-            # device = block-until-materialized; with the pipeline full
-            # this approaches pure device execution time per batch
-            t1 = time.perf_counter()
-            self._observe("device", t1 - t0)
-            for item, allowed in zip(batch.items, results):
-                f = item[2]
-                if allowed is not None and not f.done():
-                    f.set_result(bool(allowed))
-            if self.encoded_cache is not None and batch.keys is not None:
-                # a None result marks a row the fallback skipped as
-                # already-dead: nothing to cache for it
-                live = [
-                    (k, bool(v))
-                    for k, v in zip(batch.keys, results)
-                    if v is not None
-                ]
-                if live:
-                    self.encoded_cache.put_many(
-                        batch.enc.version,
-                        [k for k, _ in live],
-                        [v for _, v in live],
-                    )
-            self._complete(batch)
-            self._observe("decode", time.perf_counter() - t1)
+            if self.tracer is not None:
+                with self.tracer.span(
+                    "batcher.decode",
+                    parent=self._batch_parent(batch.items),
+                    batch_size=len(batch.items),
+                ):
+                    self._decode_step(batch, holder)
+            else:
+                self._decode_step(batch, holder)
+
+    def _decode_step(self, batch: _PBatch, holder: _Holder) -> None:
+        holder.batch = batch
+        FAULTS.fire("batcher.decode_die")
+        FAULTS.maybe_sleep("batcher.decode_slow")
+        # rows that died on device still decode (the kernel already
+        # ran; materializing frees the staging buffers) but their
+        # callers are failed typed NOW instead of after the blocking
+        # materialization — items stay in place so results align
+        now = time.monotonic()
+        n_expired = 0
+        for item in batch.items:
+            f = item[2]
+            dl = item[4]
+            if dl is not None and now >= dl and not f.done():
+                f.set_exception(DeadlineExceeded())
+                n_expired += 1
+        if n_expired:
+            self._note_expired("decode", n_expired)
+        t0 = time.perf_counter()
+        try:
+            results = self.engine.decode_launched(batch.launched)
+        except Exception as e:
+            self._fail_batch(batch, e)
             holder.batch = None
+            return
+        # device = block-until-materialized; with the pipeline full
+        # this approaches pure device execution time per batch
+        t1 = time.perf_counter()
+        self._observe("device", t1 - t0)
+        for item, allowed in zip(batch.items, results):
+            f = item[2]
+            led = item[5] if len(item) > 5 else None
+            if led is not None:
+                # kernel = launch-mark -> materialized; decode = the
+                # residual up to this row's future resolution. Marked
+                # BEFORE set_result so the woken caller's serialize/
+                # reply marks cannot race the ledger.
+                led.mark("kernel", t1)
+                led.mark("decode")
+            if allowed is not None and not f.done():
+                f.set_result(bool(allowed))
+        if self.encoded_cache is not None and batch.keys is not None:
+            # a None result marks a row the fallback skipped as
+            # already-dead: nothing to cache for it
+            live = [
+                (k, bool(v))
+                for k, v in zip(batch.keys, results)
+                if v is not None
+            ]
+            if live:
+                self.encoded_cache.put_many(
+                    batch.enc.version,
+                    [k for k, _ in live],
+                    [v for _, v in live],
+                )
+        self._complete(batch)
+        self._observe("decode", time.perf_counter() - t1)
+        holder.batch = None
 
 
 def dispatch_batched(

@@ -263,6 +263,11 @@ class ClosureCheckEngine:
         # build telemetry (read by tests and the metrics endpoint)
         self.n_full_builds = 0
         self.n_incremental_builds = 0
+        # phase breakdown of the most recent closure build (seconds):
+        # snapshot_encode / interior / matmul-or-incremental / total —
+        # the multi-minute cold build decomposed for /debug/attribution
+        # readers and the performance guide
+        self.last_build_phases: dict[str, float] = {}
         from ..telemetry.tracing import NOOP_TRACER
 
         self.tracer = tracer if tracer is not None else NOOP_TRACER
@@ -435,9 +440,15 @@ class ClosureCheckEngine:
                 and state.version == self.snapshots.store.version
             ):
                 return state  # a concurrent builder got there first
+            t_snap = time.perf_counter()
             with self.tracer.span("snapshot.encode"):
                 snap = self.snapshots.snapshot()
+            snap_s = time.perf_counter() - t_snap
             state = self._build_state(snap, prev=self._state)
+            self.last_build_phases["snapshot_encode"] = round(snap_s, 6)
+            self.last_build_phases["total"] = round(
+                self.last_build_phases.get("total", 0.0) + snap_s, 6
+            )
             if isinstance(state, _ClosureArtifacts):
                 # fresh overlay generation for the new residency. A delta
                 # racing this swap may land on the outgoing overlay and be
@@ -483,6 +494,9 @@ class ClosureCheckEngine:
     def _build_state(
         self, snap: GraphSnapshot, prev: Optional[_State]
     ) -> _State:
+        t_build = time.perf_counter()
+        phases: dict[str, float] = {}
+        self.last_build_phases = phases
         with self.tracer.span(
             "closure.build", edges=snap.num_edges, version=snap.version
         ) as span:
@@ -492,11 +506,14 @@ class ClosureCheckEngine:
                 # Checked BEFORE build_interior: the O(E) interior scan
                 # would be discarded, and rebuild kicks recur per write.
                 span.set_attr("kind", "replica-fallback")
+                phases["total"] = round(time.perf_counter() - t_build, 6)
                 return _TooBig(
                     version=snap.version, num_edges=snap.num_edges
                 )
+            t0 = time.perf_counter()
             with self.tracer.span("closure.interior"):
                 ig = build_interior(snap)
+            phases["interior"] = round(time.perf_counter() - t0, 6)
             span.set_attr("interior", ig.m)
             if ig.m > self.interior_limit or (
                 self.global_max_depth > _MAX_CLOSURE_DEPTH
@@ -511,6 +528,7 @@ class ClosureCheckEngine:
                         interior=ig.m,
                         limit=self.interior_limit,
                     )
+                phases["total"] = round(time.perf_counter() - t_build, 6)
                 return _TooBig(
                     version=snap.version, num_edges=snap.num_edges
                 )
@@ -523,15 +541,25 @@ class ClosureCheckEngine:
                     span.set_attr("kind", "incremental")
                     if self._m_builds is not None:
                         self._m_builds.labels(kind="incremental").inc()
-                    return self._incremental_artifacts(
+                    t0 = time.perf_counter()
+                    art = self._incremental_artifacts(
                         prev, snap, ig, k_max, host, new_ii
                     )
+                    phases["incremental"] = round(
+                        time.perf_counter() - t0, 6
+                    )
+                    phases["total"] = round(time.perf_counter() - t_build, 6)
+                    return art
             self.n_full_builds += 1
             span.set_attr("kind", "full")
             if self._m_builds is not None:
                 self._m_builds.labels(kind="full").inc()
+            t0 = time.perf_counter()
             with self.tracer.span("closure.matmul", interior=ig.m):
-                return _ClosureArtifacts(snap, ig, k_max, host)
+                art = _ClosureArtifacts(snap, ig, k_max, host)
+            phases["matmul"] = round(time.perf_counter() - t0, 6)
+            phases["total"] = round(time.perf_counter() - t_build, 6)
+            return art
 
     @staticmethod
     def _appended_interior_edges(

@@ -30,6 +30,7 @@ import numpy as np
 
 from ..faults import FAULTS
 from ..graph.snapshot import GraphSnapshot, SnapshotManager, _bucket
+from ..telemetry.attribution import ledger_mark
 from ..telemetry.devstats import DEVSTATS
 from ..ops.frontier import (
     batched_check_dense,
@@ -562,6 +563,11 @@ class DeviceCheckEngine:
             if launched.garbage:
                 return [float("nan")] * enc.n
             hit = np.asarray(launched.hit)
+            # the np.asarray above blocked until the kernel materialized:
+            # on the direct (caller-thread) batch paths this charges the
+            # device wait to 'kernel' on the ambient request ledger, so
+            # the host-side list conversion below lands in 'decode'
+            ledger_mark("kernel")
             DEVSTATS.record_transfer(hit.nbytes, "d2h")
             return hit[: enc.n].tolist()
         finally:

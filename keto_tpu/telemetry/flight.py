@@ -34,8 +34,13 @@ from bisect import bisect_left
 from collections import deque
 from typing import Optional
 
+from .attribution import (
+    TimeLedger,
+    reset_current_ledger,
+    set_current_ledger,
+)
 from .metrics import DEFAULT_BUCKETS, MetricsRegistry
-from .tracing import Tracer, _current_span
+from .tracing import Tracer, _current_span, parse_traceparent
 
 
 class FlightRecorder:
@@ -234,12 +239,14 @@ class CheckTelemetry:
         slo=None,
         slow_s: float = 0.25,
         stages_fn=None,
+        attribution=None,
     ):
         self.tracer = tracer
         self.flight = flight
         self.slo = slo
         self.slow_s = float(slow_s)
         self.stages_fn = stages_fn
+        self.attribution = attribution
         self._hist = None
         self._outcomes = None
         if metrics is not None:
@@ -263,8 +270,18 @@ class CheckTelemetry:
         batch_size: int = 1,
         deadline: Optional[float] = None,
         detail: Optional[dict] = None,
+        traceparent: Optional[str] = None,
+        hedge: bool = False,
     ) -> "_CheckRecord":
-        return _CheckRecord(self, transport, batch_size, deadline, detail)
+        """``traceparent`` is the raw W3C header off the wire (REST
+        header / gRPC metadata); when present the request span joins the
+        caller's trace instead of minting a new one, and the same trace
+        id flows to the exemplar and flight record. ``hedge`` tags the
+        duplicate a client-side Hedger fired."""
+        return _CheckRecord(
+            self, transport, batch_size, deadline, detail, traceparent,
+            hedge,
+        )
 
     def _classify(self, exc_type) -> str:
         if exc_type is None:
@@ -349,35 +366,89 @@ class CheckTelemetry:
 class _CheckRecord:
     __slots__ = (
         "_tel", "transport", "batch_size", "deadline", "detail",
-        "_t0", "_span", "trace_id",
+        "_t0", "_span", "trace_id", "traceparent", "hedge", "ledger",
+        "_ledger_token",
     )
 
-    def __init__(self, tel, transport, batch_size, deadline, detail):
+    def __init__(
+        self, tel, transport, batch_size, deadline, detail,
+        traceparent=None, hedge=False,
+    ):
         self._tel = tel
         self.transport = transport
         self.batch_size = batch_size
         self.deadline = deadline
         self.detail = detail
+        self.traceparent = traceparent
+        self.hedge = bool(hedge)
         self._span = None
         self.trace_id = None
+        self.ledger = None
+        self._ledger_token = None
 
     def __enter__(self):
         self._t0 = time.perf_counter()
+        # the accounting ledger shares t0 with the wall clock so the
+        # conservation check (stages sum >= 95% of wall) is exact
+        self.ledger = TimeLedger(self._t0)
+        self._ledger_token = set_current_ledger(self.ledger)
+        remote = (
+            parse_traceparent(self.traceparent)
+            if self.traceparent
+            else None
+        )
         if self._tel.tracer is not None:
+            attrs = {
+                "transport": self.transport,
+                "batch_size": self.batch_size,
+            }
+            if self.hedge:
+                attrs["hedge"] = 1
             self._span = self._tel.tracer.span(
-                CheckTelemetry.SPAN_NAME,
-                transport=self.transport,
-                batch_size=self.batch_size,
+                CheckTelemetry.SPAN_NAME, parent=remote, **attrs
             )
             self._span.__enter__()
         cur = _current_span.get()
         if cur is not None:
             self.trace_id = cur.trace_id
+        elif remote is not None:
+            # no tracer wired, but the caller still sent a trace id:
+            # exemplars and flight records adopt it so the operator can
+            # correlate by the id the client logged
+            self.trace_id = remote.trace_id
         return self
+
+    def mark(self, stage: str) -> None:
+        """Attribute time-since-last-mark to ``stage`` on this
+        request's ledger (transport handlers mark 'serialize' here)."""
+        if self.ledger is not None:
+            self.ledger.mark(stage)
 
     def __exit__(self, exc_type, exc, tb):
         duration_s = time.perf_counter() - self._t0
         outcome = self._tel._classify(exc_type)
+        detail = self.detail
+        if self.ledger is not None:
+            self.ledger.mark("reply")
+            if self._ledger_token is not None:
+                try:
+                    reset_current_ledger(self._ledger_token)
+                except ValueError:
+                    pass  # exited in a different context; ledger still ours
+                self._ledger_token = None
+            if self._tel.attribution is not None:
+                self._tel.attribution.record(
+                    self.ledger, duration_s, self.batch_size
+                )
+            if self.ledger.stages:
+                detail = dict(detail or ())
+                detail["ledger_ms"] = {
+                    k: round(v * 1000.0, 3)
+                    for k, v in self.ledger.stages.items()
+                }
+        if self.hedge:
+            detail = dict(detail or ())
+            detail["hedge"] = True
         if self._span is not None:
             self._span.attrs["outcome"] = outcome
             self._span.__exit__(exc_type, exc, tb)
@@ -388,7 +459,7 @@ class _CheckRecord:
             self.batch_size,
             self.deadline,
             self.trace_id,
-            self.detail,
+            detail,
         )
         return False
 

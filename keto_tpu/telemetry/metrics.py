@@ -296,6 +296,22 @@ def pipeline_stage_histogram(registry: MetricsRegistry) -> Histogram:
     )
 
 
+# -- wall-clock attribution telemetry ----------------------------------------
+
+
+def time_attribution_counter(registry: MetricsRegistry) -> Counter:
+    """Cumulative wall-clock seconds charged to each stage of the check
+    serving path by the accounting ledger (telemetry/attribution.py).
+    Includes an explicit ``unattributed`` series for the residual, so
+    the sum over stages equals total measured wall time."""
+    return registry.counter(
+        "keto_time_attribution_seconds_total",
+        "wall-clock seconds of check serving attributed to each ledger "
+        "stage (unattributed = residual the marks did not cover)",
+        labelnames=("stage",),
+    )
+
+
 # -- deadline / hedging telemetry --------------------------------------------
 
 # the stage label values deadline_expired_counter carries: "admission" is

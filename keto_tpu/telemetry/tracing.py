@@ -34,6 +34,65 @@ _current_span: contextvars.ContextVar[Optional["Span"]] = (
     contextvars.ContextVar("keto_tpu_span", default=None)
 )
 
+# W3C Trace Context (https://www.w3.org/TR/trace-context/) wire names.
+# TRACEPARENT_HEADER doubles as the gRPC metadata key (metadata keys are
+# lowercase by spec, and the header name already is).
+TRACEPARENT_HEADER = "traceparent"
+# marks the duplicate request a Hedger fires so server-side spans/flight
+# records can distinguish it from the primary carrying the same trace id
+HEDGE_HEADER = "x-keto-hedge"
+
+
+class SpanContext:
+    """Remote span identity parsed off a ``traceparent`` header — just
+    enough (trace id + parent span id) for a server-side span to join a
+    trace minted in another process."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+def format_traceparent(trace_id: int, span_id: int) -> str:
+    """``00-<32 hex trace>-<16 hex span>-01`` (version 00, sampled)."""
+    return f"00-{trace_id:032x}-{span_id:016x}-01"
+
+
+def parse_traceparent(value) -> Optional[SpanContext]:
+    """Parse a W3C traceparent header; None on anything malformed.
+    Per spec, all-zero trace or span ids are invalid and ignored."""
+    if not value:
+        return None
+    parts = str(value).strip().split("-")
+    if len(parts) < 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    try:
+        trace_id = int(parts[1], 16)
+        span_id = int(parts[2], 16)
+    except ValueError:
+        return None
+    if trace_id == 0 or span_id == 0:
+        return None
+    return SpanContext(trace_id, span_id)
+
+
+def mint_traceparent() -> str:
+    """A fresh client-side traceparent: new root trace, new span id.
+    Clients stamp this on the outbound request (REST header / gRPC
+    metadata) so server-side spans, flight records, and exemplars all
+    carry an id the caller knows."""
+    return format_traceparent(_new_trace_id(), _new_span_id())
+
+
+def current_traceparent() -> Optional[str]:
+    """traceparent for the active span, or None outside any span."""
+    span = _current_span.get()
+    if span is None:
+        return None
+    return format_traceparent(span.trace_id, span.span_id)
+
 
 def _new_trace_id() -> int:
     """Random 128-bit trace id (W3C/OTLP convention). Sequential
@@ -62,10 +121,17 @@ class Span:
         "attrs", "_tracer", "_token",
     )
 
-    def __init__(self, tracer: "Tracer", name: str, attrs: dict[str, Any]):
+    def __init__(
+        self,
+        tracer: "Tracer",
+        name: str,
+        attrs: dict[str, Any],
+        parent: Optional[SpanContext] = None,
+    ):
         self.name = name
         self.attrs = attrs
-        parent = _current_span.get()
+        if parent is None:
+            parent = _current_span.get()
         self.parent_id = parent.span_id if parent else None
         self.trace_id = parent.trace_id if parent else _new_trace_id()
         self.span_id = _new_span_id()
@@ -76,6 +142,9 @@ class Span:
 
     def set_attr(self, key: str, value: Any) -> None:
         self.attrs[key] = value
+
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id)
 
     def __enter__(self) -> "Span":
         self._token = _current_span.set(self)
@@ -116,8 +185,13 @@ class Tracer:
         elif provider == "otlp":
             _warn_missing_endpoint()
 
-    def span(self, name: str, **attrs) -> Span:
-        return Span(self, name, attrs)
+    def span(
+        self, name: str, parent: Optional[SpanContext] = None, **attrs
+    ) -> Span:
+        """New span. ``parent`` (a SpanContext off a remote traceparent)
+        overrides the ambient contextvar parent — the cross-process join
+        point: the server's root span adopts the caller's trace id."""
+        return Span(self, name, attrs, parent=parent)
 
     def _finish(self, span: Span) -> None:
         with self._lock:
@@ -208,6 +282,12 @@ class _OtlpExporter:
         self.endpoint = endpoint
         self.url = endpoint.rstrip("/") + "/v1/traces"
         self.service_name = service_name
+        # unique per process so a collector can tell the daemon apart
+        # from its forked replicas (restart_after_fork rebuilds the
+        # exporter, so a replica picks up its own pid here)
+        import socket as _socket
+
+        self.instance_id = f"{_socket.gethostname()}-{_os.getpid()}"
         self.interval_s = interval_s
         self._q: deque[Span] = deque(maxlen=self.MAX_QUEUE)
         self._stop = threading.Event()
@@ -291,7 +371,8 @@ class _OtlpExporter:
                 {
                     "resource": {
                         "attributes": [
-                            attr("service.name", self.service_name)
+                            attr("service.name", self.service_name),
+                            attr("service.instance.id", self.instance_id),
                         ]
                     },
                     "scopeSpans": [
@@ -324,6 +405,17 @@ class _OtlpExporter:
                                         attr(k, v)
                                         for k, v in s.attrs.items()
                                     ],
+                                    # STATUS_CODE_ERROR when the span
+                                    # exited via an exception, else OK —
+                                    # collectors use this for error-rate
+                                    # rollups and trace coloring
+                                    "status": {
+                                        "code": (
+                                            2
+                                            if "error" in s.attrs
+                                            else 1
+                                        )
+                                    },
                                 }
                                 for s in batch
                             ],

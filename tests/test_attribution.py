@@ -1,0 +1,452 @@
+"""Performance-attribution plane e2e (PR 7): cross-process trace
+propagation (client-minted W3C traceparent -> server spans, flight
+records, exemplars — hedged duplicates included), the wall-clock
+accounting ledger behind /debug/attribution and
+keto_time_attribution_seconds_total (conservation: stages must sum to the
+measured wall time), and the stdlib sampling profiler behind
+/debug/pprof + tools/flame.py."""
+
+import importlib.util
+import os
+import re
+import threading
+import time
+
+import grpc
+import httpx
+import pytest
+
+from keto_tpu.driver import Config
+from keto_tpu.telemetry.attribution import (
+    ATTRIBUTION_STAGES,
+    UNATTRIBUTED,
+    AttributionLedger,
+    TimeLedger,
+    current_ledger,
+    ledger_mark,
+    reset_current_ledger,
+    set_current_ledger,
+)
+from keto_tpu.telemetry.tracing import (
+    SpanContext,
+    current_traceparent,
+    format_traceparent,
+    mint_traceparent,
+    parse_traceparent,
+)
+from tests.test_api_server import ServerFixture
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = Config(
+        values={
+            "namespaces": [{"id": 1, "name": "videos"}],
+            "serve": {
+                "read": {"port": 0, "host": "127.0.0.1"},
+                "write": {"port": 0, "host": "127.0.0.1"},
+            },
+            "log": {"level": "error"},
+            # slow_ms 0: EVERY check is flight-recorded, so the tests can
+            # join client trace ids against /debug/flight deterministically
+            "telemetry": {"flight": {"slow_ms": 0}},
+        },
+        env={},
+    )
+    s = ServerFixture(cfg)
+    yield s
+    s.stop()
+
+
+def _trace_id_of(traceparent: str) -> str:
+    return traceparent.split("-")[1]
+
+
+def _debug(server, path: str, **params):
+    return httpx.get(
+        f"http://127.0.0.1:{server.read_port}{path}",
+        params=params,
+        timeout=30,
+    )
+
+
+def _flight_trace_ids(server) -> dict:
+    """trace_id -> list of flight records carrying it."""
+    out: dict = {}
+    for rec in _debug(server, "/debug/flight", n=500).json()["records"]:
+        tid = rec.get("trace_id")
+        if tid:
+            out.setdefault(tid, []).append(rec)
+    return out
+
+
+def _span_trace_ids(server) -> set:
+    return {
+        s["trace_id"]
+        for s in _debug(server, "/debug/traces", n=500).json()["spans"]
+    }
+
+
+class TestTraceparentHelpers:
+    def test_roundtrip(self):
+        tp = format_traceparent(0xABC123, 0x42)
+        assert tp == f"00-{0xABC123:032x}-{0x42:016x}-01"
+        ctx = parse_traceparent(tp)
+        assert isinstance(ctx, SpanContext)
+        assert ctx.trace_id == 0xABC123 and ctx.span_id == 0x42
+
+    def test_mint_parses(self):
+        ctx = parse_traceparent(mint_traceparent())
+        assert ctx is not None
+        assert ctx.trace_id != 0 and ctx.span_id != 0
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "",
+            "garbage",
+            "00-zz-11-01",
+            "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # zero trace id
+            "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+            "00-" + "1" * 31 + "-" + "1" * 16 + "-01",  # short trace id
+        ],
+    )
+    def test_rejects_malformed(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_current_traceparent_requires_active_span(self):
+        assert current_traceparent() is None
+
+
+class TestTimeLedger:
+    def test_marks_attribute_intervals(self):
+        led = TimeLedger(t0=100.0)
+        led.mark("admission", now=100.010)
+        led.mark("queue", now=100.030)
+        led.mark("kernel", now=100.031)
+        assert led.stages["admission"] == pytest.approx(0.010)
+        assert led.stages["queue"] == pytest.approx(0.020)
+        assert led.attributed() == pytest.approx(0.031)
+
+    def test_conservation_is_by_construction(self):
+        """Stages + the explicit unattributed residual must equal wall
+        time exactly — the property the bench smoke gate asserts at 95%
+        end to end."""
+        led = TimeLedger(t0=0.0)
+        now = 0.0
+        for stage, dt in [
+            ("admission", 0.001),
+            ("queue", 0.004),
+            ("encode", 0.002),
+            ("launch", 0.0005),
+            ("kernel", 0.020),
+            ("decode", 0.003),
+            ("serialize", 0.001),
+            ("reply", 0.0002),
+        ]:
+            now += dt
+            led.mark(stage, now=now)
+        wall = now + 0.0013  # some untracked tail
+        agg = AttributionLedger()
+        agg.record(led, wall_s=wall)
+        snap = agg.snapshot()
+        total = sum(
+            info["seconds"] for info in snap["stages"].values()
+        )
+        # snapshot rounds seconds to 6dp and coverage to 4dp
+        assert total == pytest.approx(wall, abs=1e-5)
+        assert snap["stages"][UNATTRIBUTED]["seconds"] == pytest.approx(
+            0.0013, abs=1e-6
+        )
+        assert snap["coverage"] == pytest.approx(
+            led.attributed() / wall, abs=1e-3
+        )
+        assert snap["coverage"] > 0.95
+
+    def test_snapshot_orders_canonical_stages_first(self):
+        led = TimeLedger(t0=0.0)
+        led.mark("kernel", now=0.5)
+        agg = AttributionLedger()
+        agg.record(led, wall_s=0.5)
+        stages = list(agg.snapshot()["stages"])
+        known = [s for s in stages if s in ATTRIBUTION_STAGES]
+        assert known == [
+            s for s in ATTRIBUTION_STAGES if s in set(known)
+        ]
+
+    def test_ambient_ledger_contextvar(self):
+        assert current_ledger() is None
+        ledger_mark("kernel")  # no ambient ledger: must be a no-op
+        led = TimeLedger(t0=0.0)
+        token = set_current_ledger(led)
+        try:
+            assert current_ledger() is led
+            ledger_mark("admission")
+            assert "admission" in led.stages
+        finally:
+            reset_current_ledger(token)
+        assert current_ledger() is None
+
+
+class TestRestTracePropagation:
+    def test_client_traceparent_reaches_spans_flight_and_exemplars(
+        self, server
+    ):
+        from keto_tpu.client import RestClient
+
+        with RestClient(f"http://127.0.0.1:{server.read_port}") as c:
+            res = c.check("videos:/cats#view@nobody")
+        assert res.traceparent
+        tid = _trace_id_of(res.traceparent)
+        assert int(tid, 16) != 0
+
+        # the same trace id must appear in server-side spans ...
+        assert tid in _span_trace_ids(server)
+        # ... in the flight record for this request ...
+        recs = _flight_trace_ids(server)
+        assert tid in recs
+        assert recs[tid][0]["transport"] == "rest"
+        # ... with the per-request ledger riding the record
+        ledger_ms = recs[tid][0].get("ledger_ms") or {}
+        assert "serialize" in ledger_ms and "reply" in ledger_ms
+        # ... and in the duration histogram's OpenMetrics exemplar
+        exposition = httpx.get(
+            f"http://127.0.0.1:{server.read_port}/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        ).text
+        assert tid in exposition
+
+    def test_explicit_traceparent_is_honored(self, server):
+        from keto_tpu.client import RestClient
+
+        tp = mint_traceparent()
+        with RestClient(f"http://127.0.0.1:{server.read_port}") as c:
+            res = c.check("videos:/cats#view@nobody", traceparent=tp)
+        assert res.traceparent == tp
+        assert _trace_id_of(tp) in _flight_trace_ids(server)
+
+    def test_batch_check_carries_trace(self, server):
+        from keto_tpu.client import RestClient
+
+        tp = mint_traceparent()
+        with RestClient(f"http://127.0.0.1:{server.read_port}") as c:
+            c.batch_check(
+                ["videos:/cats#view@a", "videos:/cats#view@b"],
+                traceparent=tp,
+            )
+        recs = _flight_trace_ids(server)
+        assert _trace_id_of(tp) in recs
+        assert recs[_trace_id_of(tp)][0]["transport"] == "rest_batch"
+
+
+class TestGrpcTracePropagation:
+    def test_grpc_check_joins_client_trace(self, server):
+        from keto_tpu.client import GrpcClient
+
+        with GrpcClient(f"127.0.0.1:{server.read_port}") as g:
+            res = g.check("videos:/cats#view@nobody")
+        tid = _trace_id_of(res.traceparent)
+        assert tid in _span_trace_ids(server)
+        recs = _flight_trace_ids(server)
+        assert tid in recs
+        assert recs[tid][0]["transport"] == "grpc"
+
+    def test_hedged_duplicate_shares_trace_and_is_tagged(self, server):
+        """The hedged-duplicate case: one traceparent, two server-side
+        requests, the reissue alone tagged hedge — so the operator can
+        tell them apart while correlating both to the one client call."""
+        from keto_tpu.client import GrpcClient, HedgePolicy, Hedger
+        from keto_tpu.faults import FAULTS
+
+        # the primary rides a one-shot 300ms replica stall; the hedge
+        # fires at 30ms, dodges it, and wins
+        FAULTS.arm_slow("replica.slow", sleep_ms=300, times=1)
+        try:
+            with GrpcClient(f"127.0.0.1:{server.read_port}") as g:
+                with Hedger(HedgePolicy(delay_s=0.03)) as h:
+                    out = g.check_hedged("videos:/cats#view@nobody", h)
+        finally:
+            FAULTS.disarm("replica.slow")
+        assert out.hedged is True
+        tid = _trace_id_of(out.result.traceparent)
+
+        # both attempts eventually finish server-side; wait for both
+        # flight records (the stalled primary lands ~300ms later)
+        deadline = time.monotonic() + 5.0
+        recs = []
+        while time.monotonic() < deadline:
+            recs = _flight_trace_ids(server).get(tid, [])
+            if len(recs) >= 2:
+                break
+            time.sleep(0.05)
+        assert len(recs) == 2, f"expected 2 flight records, got {recs}"
+        hedge_flags = sorted(bool(r.get("hedge")) for r in recs)
+        assert hedge_flags == [False, True]
+        assert tid in _span_trace_ids(server)
+
+
+class TestAttributionEndpoint:
+    def test_ledger_conservation_under_slowness(self, server):
+        """The acceptance property, end to end: with slowness faults
+        armed, /debug/attribution must still decompose batch-check wall
+        time into named stages summing to >= 95% of measured wall."""
+        from keto_tpu.client import GrpcClient, RestClient
+        from keto_tpu.faults import FAULTS
+
+        # both slowness seams, as in the bench tail phase: device.slow
+        # fires on device query paths, replica.slow on any
+        FAULTS.arm_slow("device.slow", sleep_ms=20, times=3)
+        FAULTS.arm_slow("replica.slow", sleep_ms=20, times=3)
+        try:
+            with RestClient(
+                f"http://127.0.0.1:{server.read_port}"
+            ) as rc:
+                rc.batch_check(
+                    [f"videos:/cats#view@u{i}" for i in range(32)]
+                )
+            with GrpcClient(f"127.0.0.1:{server.read_port}") as g:
+                for i in range(8):
+                    g.check(f"videos:/cats#view@w{i}")
+        finally:
+            FAULTS.disarm("device.slow")
+            FAULTS.disarm("replica.slow")
+
+        payload = _debug(server, "/debug/attribution").json()
+        snap = payload["attribution"]
+        assert snap["requests"] > 0
+        assert snap["coverage"] >= 0.95
+        # conservation: stages (incl. the explicit residual) sum to wall
+        total = sum(
+            info["seconds"] for info in snap["stages"].values()
+        )
+        # stage seconds are rounded to 6dp each in the snapshot
+        assert total == pytest.approx(snap["wall_s"], abs=1e-4)
+        # the serving stages the transports mark must be present
+        for stage in ("serialize", "reply"):
+            assert stage in snap["stages"]
+        # the engine built at boot reports its phase split alongside
+        phases = payload.get("closure_build_phases")
+        if phases:
+            assert "total" in phases
+
+    def test_attribution_counter_exposed(self, server):
+        body = httpx.get(
+            f"http://127.0.0.1:{server.read_port}/metrics"
+        ).text
+        assert "keto_time_attribution_seconds_total" in body
+        assert 'stage="serialize"' in body
+
+
+class TestSamplingProfiler:
+    def test_samples_fold_and_overhead_stays_bounded(self):
+        from keto_tpu.telemetry.profiler import SamplingProfiler
+
+        stop = threading.Event()
+
+        def busy():
+            while not stop.is_set():
+                sum(i * i for i in range(2000))
+
+        worker = threading.Thread(target=busy, name="busy-worker")
+        worker.start()
+        prof = SamplingProfiler(hz=67.0)
+        prof.start()
+        try:
+            time.sleep(0.6)
+        finally:
+            prof.stop()
+            stop.set()
+            worker.join(timeout=5)
+        snap = prof.snapshot()
+        assert snap["samples"] > 5
+        assert snap["self_overhead"] < 0.05  # the acceptance budget
+        folds = prof.folded()
+        assert any(k.startswith("busy-worker;") for k in folds)
+        # folded text is the classic `stack count` line format
+        for line in prof.folded_text().splitlines():
+            assert re.fullmatch(r".+ \d+", line)
+        # tree value equals total folded samples
+        assert prof.tree()["value"] == sum(folds.values())
+
+    def test_bounded_fold_table_truncates(self):
+        """With max_stacks=1, distinct stacks beyond the first land in
+        the [truncated] overflow bucket instead of growing the table."""
+        from keto_tpu.telemetry.profiler import SamplingProfiler
+
+        stop = threading.Event()
+
+        def loop_a():
+            while not stop.is_set():
+                time.sleep(0.01)
+
+        def loop_b():
+            while not stop.is_set():
+                time.sleep(0.01)
+
+        threads = [
+            threading.Thread(target=loop_a, name="fold-a"),
+            threading.Thread(target=loop_b, name="fold-b"),
+        ]
+        for t in threads:
+            t.start()
+        prof = SamplingProfiler(hz=67.0, max_stacks=1)
+        try:
+            for _ in range(10):
+                prof._sample_once()
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+        folds = prof.folded()
+        # one real entry at most, everything else overflowed
+        assert len(folds) <= 2
+        assert folds.get("[truncated]", 0) > 0
+        assert prof.snapshot()["truncated_stacks"] > 0
+
+    def test_pprof_endpoint_on_demand_capture(self, server):
+        r = _debug(server, "/debug/pprof", seconds=0.3)
+        assert r.status_code == 200
+        doc = r.json()
+        assert doc["profiler"]["samples"] > 0
+        # every sample lands in exactly one stack, so the tree root's
+        # subtree total equals the sample count
+        assert doc["tree"]["value"] == doc["profiler"]["samples"]
+        folded = _debug(server, "/debug/pprof", format="folded")
+        assert folded.status_code == 200
+        assert folded.text.strip()  # server threads always have frames
+
+
+class TestFlameTool:
+    def _flame(self):
+        spec = importlib.util.spec_from_file_location(
+            "flame", os.path.join(_REPO, "tools", "flame.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_folded_to_html(self):
+        flame = self._flame()
+        folds = flame.parse_folded(
+            "main;engine:check 42\nmain;api:reply 10\nbad line\n"
+        )
+        assert folds == {
+            ("main", "engine:check"): 42,
+            ("main", "api:reply"): 10,
+        }
+        tree = flame.build_tree(folds)
+        assert tree["value"] == 52
+        html = flame.render_html(tree)
+        assert "<svg" in html and "engine:check" in html
+        svg = flame.render_svg(tree)
+        assert svg.startswith("<svg") and svg.endswith("</svg>")
+
+    def test_profiler_folded_feeds_flame(self, server):
+        flame = self._flame()
+        text = _debug(server, "/debug/pprof", format="folded").text
+        folds = flame.parse_folded(text)
+        assert folds
+        html = flame.render_html(flame.build_tree(folds))
+        assert "<svg" in html

@@ -5,7 +5,11 @@
 #      upstream APIs like the jax shard_map relocation at CI time)
 #   2. bench smoke — bench.py --smoke end-to-end (tiny config, short
 #      server leg): the serving path must boot, answer, and emit its
-#      summary JSON with exit 0
+#      summary JSON with exit 0. Includes the attribution-leak gate:
+#      the wall-clock accounting ledger (/debug/attribution) must cover
+#      >= 95% of measured check wall time, else bench.py exits 3 — a
+#      refactor that drops a stage's ledger marks fails here, not in
+#      production
 #   3. chaos soak smoke — tools/soak.py: seeded deterministic fault
 #      schedule (crash/slow/nan + pool-phase drop/crash) under concurrent
 #      mixed load; answer parity, snaptoken monotonicity, no lost

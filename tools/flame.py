@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Folded stacks -> standalone flamegraph (HTML with an inline SVG).
+
+Consumes the classic folded format the sampling profiler emits
+(``/debug/pprof?format=folded``, or telemetry.profiler.folded_text()):
+one ``frame;frame;...;frame count`` line per unique stack. Produces a
+single self-contained file — no external JS/CSS, nothing fetched — safe
+to attach to a ticket or open from a support bundle.
+
+Stdlib only, like the profiler itself: the runtime image ships no
+flamegraph tooling, so this is the rendering half of the pair.
+
+Usage:
+    curl -s 'http://HOST:PORT/debug/pprof?format=folded' \
+        | python tools/flame.py > flame.html
+    python tools/flame.py --in stacks.folded --out flame.html
+    python tools/flame.py --in stacks.folded --svg --out flame.svg
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from html import escape
+
+# frame-rect layout constants (SVG user units)
+_ROW_H = 17
+_WIDTH = 1200
+_FONT = 11
+_MIN_W = 0.5  # rects narrower than this are dropped (sub-pixel noise)
+
+# muted warm palette, cycled by depth so adjacent rows read apart
+_COLORS = (
+    "#e5744c", "#e08a3c", "#d9a441", "#c9b24a",
+    "#e06a5e", "#d98a55", "#cf9a3f", "#c27d4e",
+)
+
+
+def parse_folded(text: str) -> dict[tuple[str, ...], int]:
+    """``stack;frames count`` lines -> {(frame, ...): count}. Lines that
+    do not end in an integer are skipped (headers, blank lines)."""
+    out: dict[tuple[str, ...], int] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, count_s = line.rpartition(" ")
+        if not stack:
+            continue
+        try:
+            count = int(count_s)
+        except ValueError:
+            continue
+        key = tuple(stack.split(";"))
+        out[key] = out.get(key, 0) + count
+    return out
+
+
+def build_tree(folds: dict[tuple[str, ...], int]) -> dict:
+    """Merge stacks into {name, value, children} (value = subtree
+    samples) — the same shape /debug/pprof returns as JSON."""
+    root: dict = {"name": "all", "value": 0, "children": {}}
+    for stack, count in folds.items():
+        root["value"] += count
+        node = root
+        for frame in stack:
+            child = node["children"].get(frame)
+            if child is None:
+                child = {"name": frame, "value": 0, "children": {}}
+                node["children"][frame] = child
+            child["value"] += count
+            node = child
+    return root
+
+
+def _render_rects(node: dict, x: float, depth: int, scale: float,
+                  total: int, out: list[str]) -> int:
+    """Emit one <g> per frame rect, children left-to-right by weight.
+    Returns the deepest row used (for sizing the SVG)."""
+    w = node["value"] * scale
+    deepest = depth
+    if depth >= 0 and w >= _MIN_W:  # depth -1 = synthetic root, not drawn
+        y = depth * _ROW_H
+        color = _COLORS[depth % len(_COLORS)]
+        name = escape(node["name"])
+        pct = 100.0 * node["value"] / total
+        label = name if w > 40 else ""
+        out.append(
+            f'<g><title>{name} — {node["value"]} samples '
+            f"({pct:.1f}%)</title>"
+            f'<rect x="{x:.2f}" y="{y}" width="{w:.2f}" '
+            f'height="{_ROW_H - 1}" fill="{color}" rx="1"/>'
+            + (
+                f'<text x="{x + 3:.2f}" y="{y + _ROW_H - 5}" '
+                f'font-size="{_FONT}" font-family="monospace" '
+                f'fill="#1a1a1a" clip-path="inset(0)">'
+                f"{label[: max(1, int(w / 7))]}</text>"
+                if label
+                else ""
+            )
+            + "</g>"
+        )
+    cx = x
+    for child in sorted(
+        node["children"].values(), key=lambda c: -c["value"]
+    ):
+        cw = child["value"] * scale
+        if cw < _MIN_W:
+            continue
+        deepest = max(
+            deepest,
+            _render_rects(child, cx, depth + 1, scale, total, out),
+        )
+        cx += cw
+    return deepest
+
+
+def render_svg(tree: dict) -> str:
+    total = max(1, tree["value"])
+    scale = _WIDTH / total
+    rects: list[str] = []
+    deepest = _render_rects(tree, 0.0, -1, scale, total, rects)
+    height = (deepest + 1) * _ROW_H + 4
+    return (
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{_WIDTH}" '
+        f'height="{height}" viewBox="0 0 {_WIDTH} {height}">'
+        f'<rect width="{_WIDTH}" height="{height}" fill="#fdf6ec"/>'
+        + "".join(rects)
+        + "</svg>"
+    )
+
+
+def render_html(tree: dict, title: str = "keto-tpu flamegraph") -> str:
+    total = tree["value"]
+    return f"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>{escape(title)}</title>
+<style>
+  body {{ font-family: monospace; margin: 16px; background: #fdf6ec; }}
+  h1 {{ font-size: 15px; }} p {{ font-size: 12px; color: #555; }}
+  svg {{ border: 1px solid #ddd; }}
+</style></head>
+<body>
+<h1>{escape(title)}</h1>
+<p>{total} samples — widths are sample shares; hover a frame for its
+count. Rendered by tools/flame.py from folded stacks
+(/debug/pprof?format=folded).</p>
+{render_svg(tree)}
+</body></html>
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="folded stacks -> standalone flamegraph"
+    )
+    ap.add_argument(
+        "--in", dest="infile", default="-",
+        help="folded-stacks file ('-' = stdin)",
+    )
+    ap.add_argument(
+        "--out", dest="outfile", default="-",
+        help="output file ('-' = stdout)",
+    )
+    ap.add_argument(
+        "--svg", action="store_true",
+        help="emit the bare SVG instead of the HTML wrapper",
+    )
+    ap.add_argument("--title", default="keto-tpu flamegraph")
+    args = ap.parse_args()
+
+    text = (
+        sys.stdin.read()
+        if args.infile == "-"
+        else open(args.infile).read()
+    )
+    folds = parse_folded(text)
+    if not folds:
+        print("no folded stacks in input", file=sys.stderr)
+        return 1
+    tree = build_tree(folds)
+    doc = (
+        render_svg(tree)
+        if args.svg
+        else render_html(tree, title=args.title)
+    )
+    if args.outfile == "-":
+        sys.stdout.write(doc)
+    else:
+        with open(args.outfile, "w") as f:
+            f.write(doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
