@@ -14,6 +14,10 @@ from ..relationtuple.definitions import (
     SubjectID,
     SubjectSet,
 )
+from ..replication.token import (  # noqa: F401  (LATEST_SENTINEL re-export)
+    LATEST_SENTINEL,
+    parse_snaptoken,
+)
 from ..utils.errors import ErrMalformedInput
 from . import acl_pb2, expand_service_pb2
 
@@ -25,11 +29,6 @@ _NODE_TYPE_TO_PROTO = {
 }
 _NODE_TYPE_FROM_PROTO = {v: k for k, v in _NODE_TYPE_TO_PROTO.items()}
 
-# min_version sentinel for `latest: true` — far above any real store
-# version; wait_for_version clamps it to the store's current version
-LATEST_SENTINEL = 1 << 62
-
-
 def min_version_from(snaptoken: str, latest) -> int:
     """Shared snaptoken/latest -> minimum-version parsing for BOTH
     transports (REST query params and gRPC request fields): one sentinel,
@@ -39,7 +38,10 @@ def min_version_from(snaptoken: str, latest) -> int:
     min_version = 0
     if snaptoken:
         try:
-            min_version = int(snaptoken)
+            # structured zookie ("z<version>.<segment>.<offset>") or the
+            # legacy bare version integer — freshness keys on the version
+            # component either way (replication/token.py)
+            min_version = parse_snaptoken(snaptoken).version
         except ValueError:
             raise ErrMalformedInput(
                 f"malformed snaptoken {snaptoken!r}"

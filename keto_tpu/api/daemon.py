@@ -183,6 +183,7 @@ def build_read_grpc_server(
     max_message_bytes: int = 0,
     max_freshness_wait_s=30.0,  # float or zero-arg callable (hot reload)
     telemetry=None,  # CheckTelemetry seam (spans/exemplars/SLO/flight)
+    version_waiter=None,  # follower replication gate (replication/follower.py)
 ) -> grpc.Server:
     """Read-plane gRPC: Check + Expand + Read + Version + Health +
     reflection, behind the telemetry interceptor chain (reference
@@ -200,11 +201,23 @@ def build_read_grpc_server(
         server,
         CheckServicer(
             checker, snaptoken_fn, max_freshness_wait_s=max_freshness_wait_s,
-            telemetry=telemetry,
+            telemetry=telemetry, version_waiter=version_waiter,
         ),
     )
-    add_expand_service(server, ExpandServicer(expand_engine, snaptoken_fn))
-    add_read_service(server, ReadServicer(manager))
+    add_expand_service(
+        server,
+        ExpandServicer(
+            expand_engine, snaptoken_fn, version_waiter=version_waiter,
+            max_freshness_wait_s=max_freshness_wait_s,
+        ),
+    )
+    add_read_service(
+        server,
+        ReadServicer(
+            manager, version_waiter=version_waiter,
+            max_freshness_wait_s=max_freshness_wait_s,
+        ),
+    )
     add_version_service(server, VersionServicer(version))
     add_health_service(server, health)
     add_reflection_service(server, READ_SERVICES)
@@ -215,6 +228,7 @@ def build_write_grpc_server(
     health: HealthServicer, max_workers: int = 32,
     logger=None, metrics=None, tracer=None,
     max_message_bytes: int = 0,
+    read_only: bool = False,
 ) -> grpc.Server:
     """Write-plane gRPC: Write + Version + Health + reflection (reference
     WriteGRPCServer, registry_default.go:387-401)."""
@@ -227,7 +241,9 @@ def build_write_grpc_server(
         options=grpc_message_options(max_message_bytes),
     )
     server._keto_executor = executor  # joined by PlaneServer.stop
-    add_write_service(server, WriteServicer(manager, snaptoken_fn))
+    add_write_service(
+        server, WriteServicer(manager, snaptoken_fn, read_only=read_only)
+    )
     add_version_service(server, VersionServicer(version))
     add_health_service(server, health)
     add_reflection_service(server, WRITE_SERVICES)

@@ -285,12 +285,18 @@ async def _json_body(request: web.Request):
 class ReadAPI:
     def __init__(
         self, manager, checker, expand_engine, snaptoken_fn, executor=None,
-        telemetry=None,
+        telemetry=None, version_waiter=None, max_freshness_wait_s=30.0,
     ):
         self.manager = manager
         self.checker = checker
         self.expand_engine = expand_engine
         self.snaptoken_fn = snaptoken_fn
+        # follower-only replication gate: wait_for_version(min_version,
+        # timeout_s) blocking until replay passes the token, else raising
+        # ErrFollowerLag (503 + Retry-After + lag detail). None on
+        # leaders/standalone nodes.
+        self.version_waiter = version_waiter
+        self.max_freshness_wait_s = max_freshness_wait_s
         # sized by the registry so in-flight checks can fill a device batch
         # (the loop's default executor caps at ~32 threads)
         self.executor = executor
@@ -308,6 +314,17 @@ class ReadAPI:
         app.router.add_get(ROUTE_EXPAND, self.get_expand)
         app.router.add_get("/pipeline", self.get_pipeline)
 
+    def _await_freshness(self, min_version: int, deadline=None) -> None:
+        """Blocks (executor thread, never the event loop) until the
+        follower's replay passes ``min_version``; no-op on leaders."""
+        if self.version_waiter is None or min_version <= 0:
+            return
+        cap = self.max_freshness_wait_s
+        timeout = float(cap()) if callable(cap) else float(cap)
+        if deadline is not None:
+            timeout = min(timeout, max(0.0, deadline - time.monotonic()))
+        self.version_waiter(min_version, timeout_s=timeout)
+
     async def get_pipeline(self, request: web.Request) -> web.Response:
         """keto_tpu extension: dispatch-pipeline occupancy (queue depths,
         stage layout, in-flight batches) as one JSON object — the
@@ -319,8 +336,13 @@ class ReadAPI:
     async def get_relations(self, request: web.Request) -> web.Response:
         p = request.rel_url.query
         # snaptoken (keto_tpu REST extension, mirroring the gRPC field):
-        # validated, then trivially satisfied — list reads the live store
-        _min_version_from_query(p)
+        # validated, then trivially satisfied on a leader (list reads the
+        # live store); a follower gates on replication replay first
+        min_version = _min_version_from_query(p)
+        if self.version_waiter is not None and min_version > 0:
+            await asyncio.get_running_loop().run_in_executor(
+                self.executor, self._await_freshness, min_version
+            )
         query = RelationQuery(
             namespace=p.get("namespace"),
             object=p.get("object"),
@@ -395,6 +417,7 @@ class ReadAPI:
                     "rest_batch", batch_size=len(cols), deadline=deadline,
                     traceparent=traceparent, hedge=hedge,
                 ) as rec:
+                    self._await_freshness(min_version, deadline)
                     allowed = inner()
                     text = json.dumps(
                         {
@@ -424,6 +447,7 @@ class ReadAPI:
                 "rest_batch", batch_size=len(tuples), deadline=deadline,
                 traceparent=traceparent, hedge=hedge,
             ) as rec:
+                self._await_freshness(min_version, deadline)
                 allowed = self.checker.check_batch(
                     tuples, max_depth, min_version=min_version,
                     deadline=deadline,
@@ -461,6 +485,7 @@ class ReadAPI:
                 detail={"namespace": tup.namespace},
                 traceparent=traceparent, hedge=hedge,
             ) as rec:
+                self._await_freshness(min_version, deadline)
                 allowed = self.checker.check(
                     tup,
                     max_depth,
@@ -490,10 +515,15 @@ class ReadAPI:
 
     async def get_expand(self, request: web.Request) -> web.Response:
         p = request.rel_url.query
-        # snaptoken: validated; expand serves at the live store version by
-        # construction (SnapshotManager re-encodes on read), so any token
-        # this server issued is already satisfied
-        _min_version_from_query(p)
+        # snaptoken: validated; on a leader expand serves at the live
+        # store version by construction (SnapshotManager re-encodes on
+        # read) so any token this server issued is already satisfied; a
+        # follower gates on replication replay first
+        min_version = _min_version_from_query(p)
+        if self.version_waiter is not None and min_version > 0:
+            await asyncio.get_running_loop().run_in_executor(
+                self.executor, self._await_freshness, min_version
+            )
         for key in ("namespace", "object", "relation"):
             if p.get(key) is None:
                 raise ErrMalformedInput(f"missing query parameter {key}")
@@ -510,16 +540,26 @@ class ReadAPI:
 
 
 class WriteAPI:
-    def __init__(self, manager, snaptoken_fn):
+    def __init__(self, manager, snaptoken_fn, read_only: bool = False):
         self.manager = manager
         self.snaptoken_fn = snaptoken_fn
+        # follower nodes serve this port (health/version/replication
+        # routes) but reject mutations — writes belong on the leader
+        self.read_only = read_only
 
     def register(self, app: web.Application) -> None:
         app.router.add_put(ROUTE_TUPLES, self.create_relation)
         app.router.add_delete(ROUTE_TUPLES, self.delete_relations)
         app.router.add_patch(ROUTE_TUPLES, self.patch_relations)
 
+    def _reject_if_read_only(self) -> None:
+        if self.read_only:
+            from ..utils.errors import ErrReadOnlyFollower
+
+            raise ErrReadOnlyFollower()
+
     async def create_relation(self, request: web.Request) -> web.Response:
+        self._reject_if_read_only()
         body = await _json_body(request)
         if not isinstance(body, dict):
             raise ErrMalformedInput("expected a json relation-tuple object")
@@ -531,6 +571,7 @@ class WriteAPI:
         )
 
     async def delete_relations(self, request: web.Request) -> web.Response:
+        self._reject_if_read_only()
         p = request.rel_url.query
         query = RelationQuery(
             namespace=p.get("namespace"),
@@ -542,6 +583,7 @@ class WriteAPI:
         return web.Response(status=204)
 
     async def patch_relations(self, request: web.Request) -> web.Response:
+        self._reject_if_read_only()
         body = await _json_body(request)
         if not isinstance(body, list):
             raise ErrMalformedInput("expected a json array of deltas")
@@ -630,6 +672,7 @@ def build_read_app(
     manager, checker, expand_engine, snaptoken_fn, version: str,
     cors: Optional[dict] = None, healthy_fn=None, executor=None,
     logger=None, metrics=None, telemetry=None, debug=None,
+    version_waiter=None, max_freshness_wait_s=30.0,
 ) -> web.Application:
     # telemetry outermost (sees final codes), then CORS so error
     # responses also carry the headers
@@ -642,7 +685,8 @@ def build_read_app(
     )
     ReadAPI(
         manager, checker, expand_engine, snaptoken_fn, executor,
-        telemetry=telemetry,
+        telemetry=telemetry, version_waiter=version_waiter,
+        max_freshness_wait_s=max_freshness_wait_s,
     ).register(app)
     register_common(app, version, healthy_fn, metrics)
     if debug is not None:
@@ -658,6 +702,7 @@ def build_write_app(
     manager, snaptoken_fn, version: str,
     cors: Optional[dict] = None, healthy_fn=None,
     logger=None, metrics=None,
+    read_only: bool = False, replication_source=None,
 ) -> web.Application:
     app = web.Application(
         middlewares=[
@@ -666,6 +711,12 @@ def build_write_app(
             error_middleware,
         ]
     )
-    WriteAPI(manager, snaptoken_fn).register(app)
+    WriteAPI(manager, snaptoken_fn, read_only=read_only).register(app)
     register_common(app, version, healthy_fn, metrics)
+    if replication_source is not None:
+        # leader only: /replication/{status,checkpoint,wal} for followers.
+        # The write plane is the right home — it is the internal,
+        # operator-facing port, and replication traffic must not contend
+        # with read-plane checks.
+        replication_source.register(app)
     return app

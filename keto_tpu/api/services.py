@@ -28,7 +28,12 @@ from ..relationtuple.columns import CheckColumns, proto_has_columns
 from ..telemetry.flight import NOOP_CHECK_TELEMETRY
 from ..telemetry.tracing import HEDGE_HEADER, TRACEPARENT_HEADER
 from ..relationtuple.definitions import RelationQuery, RelationTuple
-from ..utils.errors import DeadlineExceeded, ErrMalformedInput, KetoError
+from ..utils.errors import (
+    DeadlineExceeded,
+    ErrMalformedInput,
+    ErrReadOnlyFollower,
+    KetoError,
+)
 from ..utils.pagination import PaginationOptions
 from . import (
     acl_pb2,
@@ -72,6 +77,17 @@ def _trace_from_metadata(context) -> tuple:
     return traceparent, hedge
 
 
+def _await_freshness(version_waiter, min_version: int, timeout_s: float):
+    """Follower consistency gate: block until replication replays past
+    the requested snaptoken, or raise ErrFollowerLag (typed retryable
+    503 carrying the current lag). ``version_waiter`` is None on a
+    leader/standalone node — there the store is the source of truth and
+    the engine-level freshness wait suffices."""
+    if version_waiter is None or min_version <= 0:
+        return
+    version_waiter(min_version, timeout_s=timeout_s)
+
+
 def _abort(context: grpc.ServicerContext, err: Exception):
     if isinstance(err, KetoError):
         code = getattr(grpc.StatusCode, err.grpc_code, grpc.StatusCode.INTERNAL)
@@ -99,10 +115,14 @@ class CheckServicer:
         snaptoken_fn: Callable[[], str],
         max_freshness_wait_s=30.0,
         telemetry=None,
+        version_waiter=None,
     ):
         self.checker = checker
         self.snaptoken_fn = snaptoken_fn
         self._freshness_cap = max_freshness_wait_s
+        # follower-only: wait_for_version(min_version, timeout_s) blocking
+        # until replication replays past the token (replication/follower.py)
+        self.version_waiter = version_waiter
         # per-request check telemetry (span + histogram exemplar + SLO +
         # flight recorder); entered on the handler thread so the span
         # contextvar is visible inside checker.check()
@@ -161,6 +181,7 @@ class CheckServicer:
             deadline = (
                 None if remaining is None else time.monotonic() + remaining
             )
+            _await_freshness(self.version_waiter, min_version, timeout)
             entries: list = []
             context.add_callback(
                 lambda: [f.cancel() for f in entries]
@@ -203,6 +224,7 @@ class CheckServicer:
                 None if remaining is None else time.monotonic() + remaining
             )
             min_version = min_version_from(request.snaptoken, request.latest)
+            _await_freshness(self.version_waiter, min_version, timeout)
             traceparent, hedge = _trace_from_metadata(context)
             if proto_has_columns(request):
                 cols = CheckColumns.from_proto(request)
@@ -268,9 +290,17 @@ class CheckServicer:
 
 
 class ExpandServicer:
-    def __init__(self, expand_engine, snaptoken_fn: Callable[[], str]):
+    def __init__(
+        self,
+        expand_engine,
+        snaptoken_fn: Callable[[], str],
+        version_waiter=None,
+        max_freshness_wait_s=30.0,
+    ):
         self.expand_engine = expand_engine
         self.snaptoken_fn = snaptoken_fn
+        self.version_waiter = version_waiter
+        self._freshness_cap = max_freshness_wait_s
 
     def Expand(self, request, context):
         try:
@@ -279,13 +309,20 @@ class ExpandServicer:
             )
             if subject is None:
                 raise ErrMalformedInput("expand request without subject")
-            # ExpandRequest.snaptoken (at-least-as-fresh): validated, then
-            # trivially satisfied — the expand engine reads through the
-            # SnapshotManager, which re-encodes to the LIVE store version
-            # on every read, so the serving version is always >= any token
-            # this server issued. (The reference ignores the field,
-            # expand_service.proto:15.)
-            min_version_from(request.snaptoken, False)
+            # ExpandRequest.snaptoken (at-least-as-fresh): on a leader it
+            # is validated, then trivially satisfied — the expand engine
+            # reads through the SnapshotManager, which re-encodes to the
+            # LIVE store version on every read, so the serving version is
+            # always >= any token this server issued. On a FOLLOWER the
+            # local store may still be replaying toward the token, so the
+            # version waiter gates first. (The reference ignores the
+            # field, expand_service.proto:15.)
+            min_version = min_version_from(request.snaptoken, False)
+            cap = self._freshness_cap
+            cap = float(cap()) if callable(cap) else float(cap)
+            remaining = context.time_remaining()
+            timeout = cap if remaining is None else min(remaining, cap)
+            _await_freshness(self.version_waiter, min_version, timeout)
             tree = self.expand_engine.build_tree(subject, request.max_depth)
             proto_tree = tree_to_proto(tree)
             if proto_tree is None:
@@ -296,8 +333,10 @@ class ExpandServicer:
 
 
 class ReadServicer:
-    def __init__(self, manager):
+    def __init__(self, manager, version_waiter=None, max_freshness_wait_s=30.0):
         self.manager = manager
+        self.version_waiter = version_waiter
+        self._freshness_cap = max_freshness_wait_s
 
     # RelationTuple fields a ListRelationTuplesRequest.expand_mask may name
     _MASKABLE = frozenset({"namespace", "object", "relation", "subject"})
@@ -311,11 +350,17 @@ class ReadServicer:
                 q.relation,
                 q.subject if q.HasField("subject") else None,
             )
-            # snaptoken (at-least-as-fresh): validated, then trivially
-            # satisfied — the list reads the LIVE store, which is by
-            # definition at the newest version. (The reference ignores the
-            # field, read_service.proto:23.)
-            min_version_from(request.snaptoken, False)
+            # snaptoken (at-least-as-fresh): on a leader it is validated,
+            # then trivially satisfied — the list reads the LIVE store,
+            # which is by definition at the newest version. On a follower
+            # the version waiter gates until replay passes the token.
+            # (The reference ignores the field, read_service.proto:23.)
+            min_version = min_version_from(request.snaptoken, False)
+            cap = self._freshness_cap
+            cap = float(cap()) if callable(cap) else float(cap)
+            remaining = context.time_remaining()
+            timeout = cap if remaining is None else min(remaining, cap)
+            _await_freshness(self.version_waiter, min_version, timeout)
             mask = None
             # an empty path list means "no projection" (FieldMask read
             # convention), not "clear everything"
@@ -349,12 +394,22 @@ class ReadServicer:
 
 
 class WriteServicer:
-    def __init__(self, manager, snaptoken_fn: Callable[[], str]):
+    def __init__(
+        self,
+        manager,
+        snaptoken_fn: Callable[[], str],
+        read_only: bool = False,
+    ):
         self.manager = manager
         self.snaptoken_fn = snaptoken_fn
+        # follower nodes serve the write-plane PORT (health/version/
+        # replication) but reject mutations — writes belong on the leader
+        self.read_only = read_only
 
     def TransactRelationTuples(self, request, context):
         try:
+            if self.read_only:
+                raise ErrReadOnlyFollower()
             inserts: list[RelationTuple] = []
             deletes: list[RelationTuple] = []
             for delta in request.relation_tuple_deltas:
@@ -377,6 +432,8 @@ class WriteServicer:
 
     def DeleteRelationTuples(self, request, context):
         try:
+            if self.read_only:
+                raise ErrReadOnlyFollower()
             q = request.query
             query = query_from_proto_fields(
                 q.namespace,

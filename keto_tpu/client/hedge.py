@@ -27,6 +27,14 @@ Semantics, in the order they matter:
   issued, ``won`` = the hedge answered first, ``wasted`` = the primary
   answered first so the hedge's work was thrown away.
 
+With a replicated read plane the hedge target stops being "a second
+connection to the same port" and becomes "a DIFFERENT follower":
+``EndpointRouter`` picks the primary and hedge endpoints per request,
+snaptoken-aware — an endpoint already known to have replayed past the
+token's version serves the read without a server-side freshness wait,
+and the hedge always lands on another replica so it cannot queue behind
+the same slow node.
+
 ``clock`` and the executor are injectable so tests drive the schedule
 deterministically (same pattern as client/retry.py).
 """
@@ -36,7 +44,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
-from typing import Callable, Optional
+from typing import Callable, Optional, Sequence
 
 
 class HedgePolicy:
@@ -177,3 +185,93 @@ class Hedger:
         hedge_won = winner is f_hedge
         self._inc(1 if hedge_won else 2)  # won / wasted
         return HedgedCall(winner.result(), True, hedge_won, elapsed)
+
+
+class EndpointRouter:
+    """Snaptoken-aware endpoint picking across a replicated read fleet.
+
+    Tracks, per endpoint, the newest store version it is KNOWN to have
+    served (learned from successful at-least-token reads — a follower
+    that answered a ``snaptoken=z7.x.y`` read has necessarily replayed
+    through version 7) plus a short cool-off after an error. ``pick``
+    returns a ``(primary, hedge)`` pair:
+
+    - the primary prefers an endpoint already at or past ``min_version``,
+      so the server-side freshness wait is a no-op on the common path; a
+      token newer than every known endpoint version still routes (the
+      follower's bounded wait handles the catch-up);
+    - the hedge is always a DIFFERENT endpoint when one exists — hedging
+      to the same replica would queue behind the same slowness, which is
+      the failure hedging exists to escape.
+
+    All knowledge is client-observed: no extra control-plane RPCs, the
+    router converges from the traffic it routes.
+    """
+
+    def __init__(
+        self,
+        endpoints: Sequence[str],
+        cool_off_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        eps = [str(e).rstrip("/") for e in endpoints if str(e).strip()]
+        if not eps:
+            raise ValueError("EndpointRouter needs at least one endpoint")
+        self.endpoints = eps
+        self.cool_off_s = float(cool_off_s)
+        self._clock = clock
+        self._known_version = {e: 0 for e in eps}
+        self._penalty_until = {e: 0.0 for e in eps}
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def observe_version(self, endpoint: str, version: int) -> None:
+        """Endpoint served a read at least as fresh as ``version``."""
+        endpoint = str(endpoint).rstrip("/")
+        with self._lock:
+            known = self._known_version.get(endpoint)
+            if known is not None and int(version) > known:
+                self._known_version[endpoint] = int(version)
+
+    def observe_error(self, endpoint: str) -> None:
+        """Endpoint failed a read: bench it for ``cool_off_s``."""
+        endpoint = str(endpoint).rstrip("/")
+        with self._lock:
+            if endpoint in self._penalty_until:
+                self._penalty_until[endpoint] = (
+                    self._clock() + self.cool_off_s
+                )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            now = self._clock()
+            return {
+                e: {
+                    "known_version": self._known_version[e],
+                    "benched": self._penalty_until[e] > now,
+                }
+                for e in self.endpoints
+            }
+
+    def pick(self, min_version: int = 0) -> tuple[str, Optional[str]]:
+        with self._lock:
+            now = self._clock()
+            healthy = [
+                e for e in self.endpoints if self._penalty_until[e] <= now
+            ] or list(self.endpoints)  # everything benched: route anyway
+            pool = healthy
+            if min_version > 0:
+                fresh = [
+                    e
+                    for e in healthy
+                    if self._known_version[e] >= min_version
+                ]
+                if fresh:
+                    pool = fresh
+            primary = pool[self._rr % len(pool)]
+            self._rr += 1
+            others = [e for e in healthy if e != primary] or [
+                e for e in self.endpoints if e != primary
+            ]
+            hedge = others[self._rr % len(others)] if others else None
+            return primary, hedge

@@ -322,6 +322,54 @@ CONFIG_SCHEMA = {
             },
             "additionalProperties": False,
         },
+        # replicated read plane (replication/): leader ships WAL + newest
+        # checkpoint over the write plane's HTTP surface; followers boot
+        # from the checkpoint seed, replay the tail, and serve reads
+        "replication": {
+            "type": "object",
+            "properties": {
+                # "" = standalone (no replication); leader additionally
+                # requires a WAL (store.wal.dir); follower requires
+                # upstream + dir
+                "role": {"enum": ["", "leader", "follower"]},
+                # follower only: base URL of the leader's write plane,
+                # e.g. http://leader:4467
+                "upstream": {"type": "string"},
+                # follower scratch directory for the checkpoint seed
+                "dir": {"type": "string"},
+                # follower tail-poll cadence when the long-poll returns
+                # empty/errors
+                "poll_interval_ms": {"type": "number", "minimum": 1},
+                # records pulled per /replication/wal response
+                "max_records_per_poll": {"type": "integer", "minimum": 1},
+            },
+            "additionalProperties": False,
+        },
+        # per-tenant admission control in front of the check batcher
+        # (engine/qos.py): token bucket per namespace, 429 on drain
+        "qos": {
+            "type": "object",
+            "properties": {
+                "enabled": {"type": "boolean"},
+                # tokens (check rows) per second per namespace; <= 0
+                # admits everything for namespaces without an override
+                "rate": {"type": "number"},
+                "burst": {"type": "number", "minimum": 1},
+                # per-namespace {"rate": .., "burst": ..} overrides
+                "overrides": {
+                    "type": "object",
+                    "additionalProperties": {
+                        "type": "object",
+                        "properties": {
+                            "rate": {"type": "number"},
+                            "burst": {"type": "number", "minimum": 1},
+                        },
+                        "additionalProperties": False,
+                    },
+                },
+            },
+            "additionalProperties": False,
+        },
         # /debug surface on the read plane (api/debug.py)
         "debug": {
             "type": "object",
@@ -398,6 +446,15 @@ DEFAULTS = {
     # work (batch windows, flush timers) and under-counts it
     "telemetry.profiler.hz": 67.0,
     "telemetry.profiler.max_stacks": 10000,
+    "replication.role": "",
+    "replication.upstream": "",
+    "replication.dir": "",
+    "replication.poll_interval_ms": 50,
+    "replication.max_records_per_poll": 512,
+    "qos.enabled": False,
+    "qos.rate": 0.0,
+    "qos.burst": 100.0,
+    "qos.overrides": {},
     "debug.enabled": True,
     "debug.token": "",
     "debug.profile_max_s": 30,

@@ -43,6 +43,9 @@ class Registry:
         self._batcher = None
         self._checker = None
         self._engine_breaker = None
+        self._replication_source = None
+        self._replicator = None
+        self._qos = None
         self.health = HealthServicer()
         self.version = __version__
         self._read_plane: Optional[PlaneServer] = None
@@ -298,6 +301,7 @@ class Registry:
                 / 1e3,
                 stages_fn=self._stage_percentiles,
                 attribution=self.attribution(),
+                role=self.replication_role(),
             )
         return self._check_telemetry
 
@@ -410,8 +414,19 @@ class Registry:
         """Wrap the non-SQL stores in the durable write plane when
         ``store.wal.dir`` is configured (store/durable.py: WAL append
         before ack + atomic checkpoints + boot-time recovery). SQL DSNs
-        have their own durability — the knob is ignored with a warning."""
+        have their own durability — the knob is ignored with a warning.
+        Followers skip the wrap entirely: their durability IS the
+        leader's WAL, and replicated deltas apply through the plain
+        store's apply_replicated_delta path."""
         wal_dir = str(self.config.get("store.wal.dir") or "")
+        if self.replication_role() == "follower":
+            if wal_dir:
+                self.logger().warn(
+                    "store.wal.dir is set but this node is a replication "
+                    "follower; the leader's WAL is the durability "
+                    "authority — ignoring the local WAL config",
+                )
+            return store
         if not wal_dir:
             return store
         from ..store.durable import DurableTupleStore
@@ -631,13 +646,112 @@ class Registry:
                     ),
                     max_freshness_wait_s=self._freshness_cap_s,
                     tracer=self.tracer(),
+                    qos=self.qos(),
                 )
                 self._checker = self._batcher
         return self._checker
 
+    # -- replication (replication/) -------------------------------------------
+
+    def replication_role(self) -> str:
+        """"" (standalone), "leader", or "follower"."""
+        return str(self.config.get("replication.role") or "")
+
+    def replication_source(self):
+        """The leader's WAL/checkpoint shipping surface; its routes are
+        registered on the write-plane app. None off-leader."""
+        if (
+            self._replication_source is None
+            and self.replication_role() == "leader"
+        ):
+            store = self.store()
+            if not hasattr(store, "wal"):
+                raise ErrMalformedInput(
+                    "replication.role=leader requires a durable store "
+                    "(set store.wal.dir on a memory/columnar DSN)"
+                )
+            from ..replication.leader import ReplicationSource
+
+            self._replication_source = ReplicationSource(
+                store,
+                poll_interval_s=float(
+                    self.config.get("replication.poll_interval_ms")
+                )
+                / 1e3,
+            )
+        return self._replication_source
+
+    def replicator(self):
+        """The follower's replication client: checkpoint bootstrap + WAL
+        tail replay into the local store. None off-follower."""
+        if self._replicator is None and self.replication_role() == "follower":
+            upstream = str(self.config.get("replication.upstream") or "")
+            if not upstream:
+                raise ErrMalformedInput(
+                    "replication.role=follower requires "
+                    "replication.upstream (the leader's write-plane URL)"
+                )
+            scratch = str(self.config.get("replication.dir") or "")
+            if not scratch:
+                import tempfile
+
+                scratch = tempfile.mkdtemp(prefix="keto-follower-")
+            from ..replication.follower import FollowerReplicator
+
+            self._replicator = FollowerReplicator(
+                self.store(),
+                upstream,
+                scratch_dir=scratch,
+                poll_interval_s=float(
+                    self.config.get("replication.poll_interval_ms")
+                )
+                / 1e3,
+                max_records=int(
+                    self.config.get("replication.max_records_per_poll")
+                ),
+            )
+            self._replicator.bind_metrics(self.metrics())
+        return self._replicator
+
+    def version_waiter(self):
+        """The follower's snaptoken gate (wait_for_version), threaded into
+        the read-plane servicers/handlers; None on leaders/standalone —
+        there the store is authoritative and the engine-level freshness
+        wait suffices. This placement matters: the engine's own wait
+        clamps its target to the local store version (correct locally,
+        stale on a follower mid-replay), so the follower gate must run
+        BEFORE the batcher, unclamped."""
+        rep = self.replicator()
+        return rep.wait_for_version if rep is not None else None
+
+    def qos(self):
+        """Per-tenant token-bucket admission (engine/qos.py), handed to
+        the CheckBatcher's entry points. None unless qos.enabled."""
+        if self._qos is None and bool(
+            self.config.get("qos.enabled", default=False)
+        ):
+            from ..engine.qos import NamespaceQos
+
+            self._qos = NamespaceQos(
+                rate=float(self.config.get("qos.rate", default=0.0)),
+                burst=float(self.config.get("qos.burst", default=100.0)),
+                overrides=dict(
+                    self.config.get("qos.overrides", default={}) or {}
+                ),
+                metrics=self.metrics(),
+            )
+        return self._qos
+
     def snaptoken(self) -> str:
-        """Write-plane snaptoken: the store's durable version."""
-        return str(self.store().version)
+        """Write-plane snaptoken: the store's durable position — a
+        structured zookie (z<version>.<segment>.<offset>) on WAL-backed
+        stores, the bare version counter otherwise (replication/token.py
+        parses both)."""
+        store = self.store()
+        current_token = getattr(store, "current_token", None)
+        if current_token is not None:
+            return str(current_token())
+        return str(store.version)
 
     def _served_version(self) -> int:
         """The version checks are actually answered at (engine-served
@@ -702,6 +816,7 @@ class Registry:
                 ),
                 max_freshness_wait_s=self._freshness_cap_s,
                 telemetry=self.check_telemetry(),
+                version_waiter=self.version_waiter(),
             )
             app = build_read_app(
                 self.store(),
@@ -716,6 +831,8 @@ class Registry:
                 metrics=self.metrics(),
                 telemetry=self.check_telemetry(),
                 debug=self.debug_context(),
+                version_waiter=self.version_waiter(),
+                max_freshness_wait_s=self._freshness_cap_s,
             )
             self._read_plane = PlaneServer(
                 grpc_server,
@@ -758,6 +875,7 @@ class Registry:
                 max_message_bytes=int(
                     self.config.get("serve.write.grpc-max-message-size")
                 ),
+                read_only=self.replication_role() == "follower",
             )
             app = build_write_app(
                 self.store(),
@@ -767,6 +885,8 @@ class Registry:
                 healthy_fn=self.health.is_serving,
                 logger=self.logger(),
                 metrics=self.metrics(),
+                read_only=self.replication_role() == "follower",
+                replication_source=self.replication_source(),
             )
             self._write_plane = PlaneServer(
                 grpc_server,
@@ -814,6 +934,20 @@ class Registry:
             # derived CSR
             self._prime_recovered_csr(store)
             store.csr_provider = self._checkpoint_csr
+        replicator = self.replicator()
+        if replicator is not None:
+            # follower: seed from the leader's checkpoint and start the
+            # tail thread BEFORE warmup, so the warmed snapshot/closure
+            # covers the seeded graph instead of an empty store
+            log.info("follower bootstrap", upstream=replicator.upstream)
+            await asyncio.get_running_loop().run_in_executor(
+                None, replicator.start
+            )
+            log.info(
+                "follower replication started",
+                version=replicator.store.version,
+                leader_version=replicator.leader_version,
+            )
         # Warmup runs on a DEDICATED executor that is fully shut down
         # afterwards: the replica fork below must happen with no stray
         # threads alive (fork-after-threads is the deadlock lottery
@@ -1160,6 +1294,10 @@ class Registry:
             await self._write_plane.stop()
         if self._batcher is not None:
             self._batcher.close()
+        if self._replicator is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._replicator.stop
+            )
         if self._store is not None and hasattr(self._store, "close_durable"):
             # final checkpoint + WAL close: the next boot recovers from
             # the checkpoint instead of replaying the whole log

@@ -144,9 +144,11 @@ class CheckBatcher:
         # hot-reloadable knob (serve.read.max_freshness_wait_s)
         max_freshness_wait_s=30.0,
         tracer=None,  # stage spans join the caller's trace when set
+        qos=None,  # NamespaceQos: per-tenant token-bucket admission
     ):
         self.engine = engine
         self.tracer = tracer
+        self.qos = qos
         self.max_batch = max_batch
         self.window_s = window_s
         self.cache = cache
@@ -318,6 +320,11 @@ class CheckBatcher:
     ) -> bool:
         if self._closed:
             raise BatcherClosed()
+        if self.qos is not None:
+            # per-tenant admission precedes everything: a throttled
+            # tenant must not consume queue slots, cache probes, or a
+            # freshness wait
+            self.qos.admit(request.namespace)
         if deadline is not None:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -411,6 +418,11 @@ class CheckBatcher:
         an engine dispatch."""
         if self._closed:
             raise BatcherClosed()
+        if self.qos is not None:
+            counts: dict[str, int] = {}
+            for r in requests:
+                counts[r.namespace] = counts.get(r.namespace, 0) + 1
+            self.qos.admit_counts(counts)
         if deadline is not None:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -491,6 +503,11 @@ class CheckBatcher:
         n = len(cols)
         if n == 0:
             return []
+        if self.qos is not None:
+            counts: dict[str, int] = {}
+            for ns in cols.namespaces:
+                counts[ns] = counts.get(ns, 0) + 1
+            self.qos.admit_counts(counts)
         if min_version > 0:
             wait = getattr(self.engine, "wait_for_version", None)
             if wait is not None:

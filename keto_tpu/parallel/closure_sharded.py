@@ -56,7 +56,7 @@ from ..graph.interior import InteriorGraph, build_interior
 from ..graph.snapshot import GraphSnapshot, SnapshotManager
 from ..ops.closure import INF_DIST, build_closure_packed, pack_adjacency
 from ..relationtuple.definitions import RelationTuple, SubjectID, SubjectSet
-from .sharded import make_mesh
+from .sharded import _SM_NOCHECK, make_mesh
 
 
 def _stripe_csr(
@@ -237,7 +237,7 @@ def _sharded_closure_check(
             P("data"), P("data"), P("data"), P("data"),
         ),
         out_specs=(P("data"), P("data")),
-        check_vma=False,
+        **_SM_NOCHECK,
     )(
         d, f0_indptr, f0_vals, l_indptr, l_vals, int_idx,
         out_indptr, out_vals,

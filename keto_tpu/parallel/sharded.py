@@ -34,6 +34,16 @@ try:  # jax >= 0.6 re-exports shard_map at top level
 except ImportError:  # jax 0.4.x: experimental home
     from jax.experimental.shard_map import shard_map
 
+import inspect as _inspect
+
+# jax 0.6 renamed check_rep -> check_vma; probe which spelling this jax
+# takes so the replication check stays off under either API
+_SM_NOCHECK = (
+    {"check_vma": False}
+    if "check_vma" in _inspect.signature(shard_map).parameters
+    else {"check_rep": False}
+)
+
 from ..engine.check import DEFAULT_MAX_DEPTH
 from ..graph.snapshot import GraphSnapshot, SnapshotManager
 from ..relationtuple.definitions import RelationTuple, SubjectSet
@@ -122,7 +132,7 @@ def sharded_check(
         mesh=mesh,
         in_specs=(P("edge"), P("edge"), P("data"), P("data"), P("data")),
         out_specs=P("data"),
-        check_vma=False,
+        **_SM_NOCHECK,
     )(src, dst, start, target, depth)
 
 

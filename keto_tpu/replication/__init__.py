@@ -1,0 +1,19 @@
+"""Replicated read plane: WAL shipping from a durable leader to follower
+replicas, plus the snaptoken (zookie) machinery that makes cross-replica
+consistency real.
+
+Layout:
+
+- :mod:`.token` — the structured snaptoken ``z<version>.<segment>.<offset>``
+  every write acks with, and its parser (bare integer tokens from older
+  clients stay accepted).
+- :mod:`.leader` — the leader-side replication source: checkpoint seed +
+  WAL tail served over the write plane's HTTP surface.
+- :mod:`.follower` — the follower-side replicator: checkpoint bootstrap,
+  tail replay through the store's ordered delta feed, snaptoken waits,
+  and shared-disk promotion.
+"""
+
+from .token import SnapToken, encode_snaptoken, parse_snaptoken  # noqa: F401
+from .leader import ReplicationSource  # noqa: F401
+from .follower import FollowerReplicator  # noqa: F401

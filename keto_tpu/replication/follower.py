@@ -1,0 +1,398 @@
+"""Follower-side replicator: checkpoint bootstrap + WAL tail replay.
+
+A follower owns a plain (non-durable) memory/columnar store and keeps it
+converged with a leader's durable write plane:
+
+1. **Bootstrap** — fetch ``/replication/checkpoint`` from the upstream,
+   restore it into the local store (raw transplant, then one rebuild
+   notification so the snapshot layer re-encodes), and start tailing.
+2. **Tail** — long-poll ``/replication/wal`` with a ``(segment, offset)``
+   cursor; every shipped frame replays through
+   ``store.apply_replicated_delta`` — the store's ordered-notification
+   path — so the follower's snapshot/CSR pipeline sees deltas exactly as
+   it would local writes. Duplicate records after a reconnect are no-ops
+   (version-guarded), a ``reset`` answer or an unreplayable bulk marker
+   re-seeds from a fresh checkpoint.
+3. **Waits** — ``wait_for_version`` blocks a snaptoken-pinned read until
+   replay passes the token, bounded by the read plane's freshness window;
+   on timeout it raises the typed, retryable
+   :class:`~keto_tpu.utils.errors.ErrFollowerLag` carrying the current
+   lag. With a zero window it bounces immediately — the two consistency
+   modes the API layer exposes.
+4. **Promotion** — ``promote(wal_dir)`` replays the leader's on-disk WAL
+   suffix directly (shared-disk failover). Because the leader never acks
+   a write before its WAL frame is durable, a promoted follower holds
+   every acked write by construction; the soak drill SIGKILLs the leader
+   mid-traffic and asserts exactly that.
+
+Transport is stdlib ``urllib`` on a daemon thread: the follower's tail
+loop must not depend on any event loop, and the payloads are small JSON
+documents plus one checkpoint file at bootstrap.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from ..graph import checkpoint as ckpt_mod
+from ..store.wal import WriteAheadLog, record_from_doc
+from ..utils.errors import ErrFollowerLag
+from .token import LATEST_SENTINEL
+
+log = logging.getLogger("keto.replication.follower")
+
+_KIND_OF = {"InMemoryTupleStore": "memory", "ColumnarTupleStore": "columnar"}
+
+
+class ReplicationError(RuntimeError):
+    """Bootstrap/tail failure the replicator could not retry through."""
+
+
+def _notify_rebuild(store, version: int) -> None:
+    """Fire the store's change feed with a None-delta ("unknown change,
+    rebuild") after a raw checkpoint transplant — the same signal
+    ``bulk_load_edges`` emits, so the snapshot layer re-encodes."""
+    for fn in getattr(store, "_listeners", ()):
+        fn(version)
+    for fn in getattr(store, "_delta_listeners", ()):
+        fn(version, None, None)
+
+
+class FollowerReplicator:
+    """Keeps ``store`` converged with the leader at ``upstream`` (the
+    leader's write-plane HTTP base URL, e.g. ``http://127.0.0.1:4467``)."""
+
+    def __init__(
+        self,
+        store,
+        upstream: str,
+        *,
+        scratch_dir: str,
+        poll_interval_s: float = 0.05,
+        wait_ms: float = 1000.0,
+        max_records: int = 512,
+        http_timeout_s: float = 10.0,
+        clock=time.monotonic,
+    ):
+        kind = _KIND_OF.get(type(store).__name__)
+        if kind is None:
+            raise ReplicationError(
+                f"follower cannot replicate into {type(store).__name__}; "
+                "expected the memory or columnar store"
+            )
+        self.store = store
+        self.kind = kind
+        self.upstream = upstream.rstrip("/")
+        self.scratch_dir = scratch_dir
+        self.poll_interval_s = max(0.005, float(poll_interval_s))
+        self.wait_ms = max(0.0, float(wait_ms))
+        self.max_records = max(1, int(max_records))
+        self.http_timeout_s = float(http_timeout_s)
+        self._clock = clock
+
+        self._cursor: list[int] = [0, 0]  # [segment_first_version, offset]
+        self.leader_version = 0  # newest version the leader has reported
+        self.applied_total = 0
+        self.reseeds_total = 0
+        self.last_error: Optional[str] = None
+        self.role = "follower"
+        self._last_contact: Optional[float] = None
+        self._last_apply: Optional[float] = None
+        self._lag_since: Optional[float] = None
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._m_applied = None
+        self._m_reseeds = None
+
+    # -- transport ------------------------------------------------------------
+
+    def _get(self, path: str, params: Optional[dict] = None):
+        url = self.upstream + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, method="GET")
+        return urllib.request.urlopen(req, timeout=self.http_timeout_s)
+
+    def _get_json(self, path: str, params: Optional[dict] = None) -> dict:
+        with self._get(path, params) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    # -- bootstrap / reseed ---------------------------------------------------
+
+    def bootstrap(self) -> dict:
+        """Seed the local store from the leader's newest checkpoint and
+        record the leader's position. Raises on an unreachable or
+        incompatible upstream — a follower that cannot seed must not
+        start serving."""
+        status = self._get_json("/replication/status")
+        self.leader_version = int(status.get("version", 0))
+        self._last_contact = self._clock()
+        seeded = self._fetch_and_restore_checkpoint()
+        with self._cv:
+            self._cv.notify_all()
+        return {
+            "seeded_version": self.store.version if seeded else 0,
+            "leader_version": self.leader_version,
+        }
+
+    def _fetch_and_restore_checkpoint(self) -> bool:
+        os.makedirs(self.scratch_dir, exist_ok=True)
+        seed_path = os.path.join(self.scratch_dir, "seed-checkpoint.npz")
+        with self._get("/replication/checkpoint") as resp:
+            if resp.status == 204:
+                return False  # empty leader: tail-only from version 0
+            tmp = seed_path + ".tmp"
+            with open(tmp, "wb") as f:
+                while True:
+                    chunk = resp.read(1 << 20)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+            os.replace(tmp, seed_path)
+        ckpt = ckpt_mod.load_checkpoint(seed_path)
+        if ckpt.kind != self.kind:
+            raise ReplicationError(
+                f"leader checkpoint is kind {ckpt.kind!r} but this "
+                f"follower's store is {self.kind!r}"
+            )
+        ckpt.restore_into(self.store)
+        _notify_rebuild(self.store, ckpt.version)
+        return True
+
+    def _reseed(self) -> None:
+        """Re-seed from a fresh checkpoint after a ``reset`` (cursor
+        pruned away) or an unreplayable bulk marker. The leader cuts a
+        synchronous checkpoint right after every bulk load, so the new
+        seed always covers the unreplayable range."""
+        self.reseeds_total += 1
+        if self._m_reseeds is not None:
+            self._m_reseeds.inc()
+        self._fetch_and_restore_checkpoint()
+        with self._cv:
+            self._cv.notify_all()
+
+    # -- tail loop ------------------------------------------------------------
+
+    def start(self) -> None:
+        """Bootstrap synchronously, then tail on a daemon thread."""
+        self.bootstrap()
+        self._thread = threading.Thread(
+            target=self._tail_loop, name="keto-replication-tail", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.http_timeout_s + 5.0)
+            self._thread = None
+
+    def _tail_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once(wait_ms=self.wait_ms)
+                self.last_error = None
+            except Exception as e:
+                # an unreachable leader is a lag condition, not a crash:
+                # keep retrying, surface the error on the lag() panel
+                self.last_error = f"{type(e).__name__}: {e}"
+                self._stop.wait(self.poll_interval_s * 4)
+                continue
+            if self._stop.is_set():
+                return
+            # long-poll returned promptly with nothing: small breather
+            if not self.lag_versions():
+                self._stop.wait(self.poll_interval_s)
+
+    def poll_once(self, wait_ms: float = 0.0) -> int:
+        """One pull+apply cycle; returns records applied. Public so tests
+        and the in-process gate can drive replication deterministically."""
+        out = self._get_json(
+            "/replication/wal",
+            {
+                "segment": self._cursor[0],
+                "offset": self._cursor[1],
+                "max_records": self.max_records,
+                "wait_ms": int(wait_ms),
+            },
+        )
+        now = self._clock()
+        self._last_contact = now
+        self.leader_version = max(
+            self.leader_version, int(out.get("leader_version", 0))
+        )
+        if out.get("reset"):
+            log.warning(
+                "replication cursor %s was pruned on the leader; "
+                "re-seeding from checkpoint",
+                self._cursor,
+            )
+            self._reseed()
+            self._cursor = [0, 0]
+            return 0
+        applied = 0
+        for doc in out.get("records", ()):
+            rec = record_from_doc(doc)
+            if rec.kind == "bulk":
+                if rec.version > self.store.version:
+                    self._reseed()
+                continue
+            if self.store.apply_replicated_delta(
+                rec.version, rec.inserted, rec.deleted
+            ):
+                applied += 1
+        nxt = out.get("next")
+        if nxt:
+            self._cursor = [int(nxt[0]), int(nxt[1])]
+        if applied:
+            self.applied_total += applied
+            self._last_apply = now
+            if self._m_applied is not None:
+                self._m_applied.inc(applied)
+            with self._cv:
+                self._cv.notify_all()
+        self._update_lag_clock()
+        return applied
+
+    def _update_lag_clock(self) -> None:
+        if self.lag_versions() == 0:
+            self._lag_since = None
+        elif self._lag_since is None:
+            self._lag_since = self._clock()
+
+    # -- lag / status ---------------------------------------------------------
+
+    def lag_versions(self) -> int:
+        return max(0, self.leader_version - self.store.version)
+
+    def lag_seconds(self) -> float:
+        if self._lag_since is None:
+            return 0.0
+        return self._clock() - self._lag_since
+
+    def staleness_seconds(self) -> float:
+        """Seconds since the last successful upstream contact — the
+        "is this follower even connected" alert signal."""
+        if self._last_contact is None:
+            return float("inf")
+        return self._clock() - self._last_contact
+
+    def lag(self) -> dict:
+        return {
+            "role": self.role,
+            "upstream": self.upstream,
+            "version": self.store.version,
+            "leader_version": self.leader_version,
+            "lag_versions": self.lag_versions(),
+            "lag_seconds": round(self.lag_seconds(), 3),
+            "staleness_seconds": round(self.staleness_seconds(), 3)
+            if self._last_contact is not None
+            else None,
+            "cursor": list(self._cursor),
+            "applied_total": self.applied_total,
+            "reseeds_total": self.reseeds_total,
+            "last_error": self.last_error,
+        }
+
+    def bind_metrics(self, metrics) -> None:
+        metrics.gauge(
+            "keto_replication_lag_versions",
+            "store versions the follower is behind the leader",
+            fn=lambda: float(self.lag_versions()),
+        )
+        metrics.gauge(
+            "keto_replication_lag_seconds",
+            "seconds this follower has continuously been behind "
+            "(0 when caught up)",
+            fn=self.lag_seconds,
+        )
+        metrics.gauge(
+            "keto_replication_staleness_seconds",
+            "seconds since the follower last heard from the leader",
+            fn=lambda: min(self.staleness_seconds(), 1e9),
+        )
+        self._m_applied = metrics.counter(
+            "keto_replication_applied_total",
+            "leader deltas replayed into the follower store",
+        )
+        self._m_reseeds = metrics.counter(
+            "keto_replication_reseeds_total",
+            "checkpoint re-seeds (pruned cursor or bulk marker)",
+        )
+
+    # -- snaptoken waits ------------------------------------------------------
+
+    def wait_for_version(self, min_version: int, timeout_s: float = 0.0):
+        """Block until replay passes ``min_version`` or the freshness
+        window closes. ``LATEST_SENTINEL``-or-above means "the leader's
+        newest version as of this request's arrival". With
+        ``timeout_s <= 0`` a behind follower bounces immediately —
+        that's the at-least-token consistency mode's reject path."""
+        target = int(min_version)
+        if target >= LATEST_SENTINEL:
+            target = max(self.leader_version, self.store.version)
+        deadline = self._clock() + max(0.0, float(timeout_s))
+        with self._cv:
+            while True:
+                current = self.store.version
+                if current >= target:
+                    return current
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    raise ErrFollowerLag(
+                        lag_versions=max(
+                            target - current, self.lag_versions()
+                        ),
+                        lag_seconds=self.lag_seconds(),
+                    )
+                self._cv.wait(min(remaining, 0.25))
+
+    # -- promotion ------------------------------------------------------------
+
+    def promote(self, wal_dir: str) -> dict:
+        """Shared-disk failover: stop tailing, replay the (dead) leader's
+        WAL suffix straight off disk, and become the authority. Every
+        acked write is in that log (WAL-before-ack), so promotion loses
+        nothing acknowledged. Returns a small report for the drill."""
+        self.stop()
+        records, stats = WriteAheadLog.replay(wal_dir)
+        applied = 0
+        gap = stats.gap
+        for rec in records:
+            if rec.version <= self.store.version:
+                continue
+            if rec.kind == "bulk":
+                # beyond both our seed and any checkpoint we could fetch
+                # from the dead leader's serving plane — flag it loudly
+                gap = True
+                continue
+            if self.store.apply_replicated_delta(
+                rec.version, rec.inserted, rec.deleted
+            ):
+                applied += 1
+        self.role = "leader"
+        self.leader_version = self.store.version
+        with self._cv:
+            self._cv.notify_all()
+        if gap:
+            log.error(
+                "promotion replayed a log with gaps; acked writes may "
+                "be missing (notes: %s)", "; ".join(stats.notes) or "none",
+            )
+        return {
+            "applied": applied,
+            "final_version": self.store.version,
+            "gap": gap,
+        }

@@ -1,0 +1,218 @@
+"""Leader-side replication source: checkpoint seed + WAL tail over HTTP.
+
+The leader's durable write plane (store/durable.py) already persists
+everything a replica needs: an atomic checkpoint of the full store and a
+segmented WAL of every delta since. This module serves both over three
+routes mounted on the write plane's REST app (the write plane is the
+natural home — replication is a consumer of the *write* log, and the
+read plane stays untouched on the leader):
+
+- ``GET /replication/status`` — role, store version, WAL cursor position,
+  newest checkpoint version. Followers use it to size their lag.
+- ``GET /replication/checkpoint`` — the newest checkpoint ``.npz`` bytes
+  (streamed), version in the ``X-Keto-Checkpoint-Version`` header. Cut on
+  demand when none exists yet. 204 while the store is empty.
+- ``GET /replication/wal?segment=S&offset=O&max_records=N&wait_ms=M`` —
+  frames decoded from segment ``S`` (named by its first version, like the
+  filename) starting at byte ``O``; the response carries the records as
+  raw frame documents plus the ``next`` cursor to resume from, so the
+  stream is resumable after any disconnect by construction. A fully
+  consumed, rotated-away segment advances the cursor to the next segment;
+  a cursor naming a *pruned* segment answers ``reset: true`` — the
+  follower re-seeds from the checkpoint. ``wait_ms`` long-polls so a
+  quiet leader doesn't force hot polling.
+
+Serving reads the segment files directly (shared-nothing with the append
+handle except the filesystem), reusing the WAL's own frame parser — the
+torn-tail contract carries over: an incomplete frame at the active tail
+simply isn't shipped yet.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import zlib
+from typing import Optional
+
+from aiohttp import web
+
+from ..graph import checkpoint as ckpt_mod
+from ..store.wal import _FILE_MAGIC, _FRAME, _MAX_PAYLOAD, _list_segments
+
+log = logging.getLogger("keto.replication.leader")
+
+#: hard cap on records per /replication/wal response regardless of the
+#: follower's ask — bounds response size and handler wall time
+MAX_RECORDS_CAP = 4096
+
+
+def read_wal_from(
+    directory: str,
+    segment: int,
+    offset: int,
+    max_records: int = 512,
+) -> dict:
+    """One replication pull: decode up to ``max_records`` frame documents
+    from the cursor ``(segment, offset)``. Returns::
+
+        {"records": [...], "next": [segment, offset],
+         "reset": bool, "eof": bool}
+
+    ``eof`` means the cursor reached the durable tail (nothing more on
+    disk right now); ``reset`` means the cursor names a segment that no
+    longer exists (pruned past) and the follower must re-seed.
+    """
+    max_records = max(1, min(int(max_records), MAX_RECORDS_CAP))
+    segs = _list_segments(directory)
+    if not segs:
+        return {
+            "records": [], "next": [segment, offset],
+            "reset": False, "eof": True,
+        }
+    firsts = [f for f, _ in segs]
+    if segment == 0:
+        # fresh follower with no cursor: start at the oldest segment
+        segment, offset = firsts[0], 0
+    if segment not in firsts:
+        # pruned (or never-existed) segment: only the checkpoint can
+        # cover the missing range
+        return {
+            "records": [], "next": [segment, offset],
+            "reset": True, "eof": False,
+        }
+    idx = firsts.index(segment)
+    final = idx == len(segs) - 1
+    with open(segs[idx][1], "rb") as f:
+        data = f.read()
+    size = len(data)
+    if offset < len(_FILE_MAGIC):
+        if size < len(_FILE_MAGIC):
+            # segment file created but magic not landed yet (only
+            # possible on the active tail): nothing to ship
+            return {
+                "records": [], "next": [segment, 0],
+                "reset": False, "eof": True,
+            }
+        offset = len(_FILE_MAGIC)
+    records: list[dict] = []
+    off = offset
+    complete = False  # parsed through everything currently on disk
+    while len(records) < max_records:
+        if off + _FRAME.size > size:
+            complete = True
+            break
+        crc, ln = _FRAME.unpack_from(data, off)
+        frame_end = off + _FRAME.size + ln
+        if ln > _MAX_PAYLOAD or frame_end > size:
+            complete = True  # torn/short tail: not acked, not shipped
+            break
+        payload = data[off + _FRAME.size:frame_end]
+        if zlib.crc32(payload) != crc:
+            complete = True  # same contract as replay's tail handling
+            break
+        try:
+            records.append(json.loads(payload.decode("utf-8")))
+        except ValueError:
+            complete = True
+            break
+        off = frame_end
+    if complete and not final:
+        # a non-final segment gets no more appends: whatever stopped the
+        # parse (clean end or damage replay would also stop at), the
+        # cursor moves on to the next segment
+        return {
+            "records": records, "next": [firsts[idx + 1], 0],
+            "reset": False, "eof": False,
+        }
+    return {
+        "records": records, "next": [segment, off],
+        "reset": False, "eof": complete,
+    }
+
+
+class ReplicationSource:
+    """The leader's serving half, bound to a ``DurableTupleStore``."""
+
+    def __init__(self, store, *, poll_interval_s: float = 0.05):
+        self.store = store  # DurableTupleStore (has .wal, .checkpoint_dir)
+        self.poll_interval_s = max(0.005, float(poll_interval_s))
+
+    # -- payloads -------------------------------------------------------------
+
+    def status(self) -> dict:
+        segment, offset = self.store.wal.position()
+        return {
+            "role": "leader",
+            "version": self.store.version,
+            "wal": {"segment": segment, "offset": offset},
+            "checkpoint_version": self.store.last_checkpoint_version(),
+            "t": time.time(),
+        }
+
+    def checkpoint_entry(self) -> Optional[tuple[int, str]]:
+        """(version, path) of the newest checkpoint, cutting one on
+        demand the first time a follower asks while only WAL exists."""
+        latest = ckpt_mod.latest_checkpoint(self.store.checkpoint_dir)
+        if latest is None and (
+            self.store.version > 0 or len(self.store) > 0
+        ):
+            self.store.checkpoint_now()
+            latest = ckpt_mod.latest_checkpoint(self.store.checkpoint_dir)
+        return latest
+
+    # -- aiohttp handlers -----------------------------------------------------
+
+    async def handle_status(self, request: web.Request) -> web.Response:
+        return web.json_response(self.status())
+
+    async def handle_checkpoint(self, request: web.Request) -> web.StreamResponse:
+        entry = await asyncio.get_running_loop().run_in_executor(
+            None, self.checkpoint_entry
+        )
+        if entry is None:
+            return web.Response(status=204)
+        version, path = entry
+        return web.FileResponse(
+            path,
+            headers={
+                "X-Keto-Checkpoint-Version": str(version),
+                "Content-Type": "application/octet-stream",
+            },
+        )
+
+    async def handle_wal(self, request: web.Request) -> web.Response:
+        q = request.rel_url.query
+        try:
+            segment = int(q.get("segment", 0))
+            offset = int(q.get("offset", 0))
+            max_records = int(q.get("max_records", 512))
+            wait_ms = min(float(q.get("wait_ms", 0)), 30_000.0)
+        except ValueError:
+            return web.json_response(
+                {"error": "malformed replication cursor"}, status=400
+            )
+        loop = asyncio.get_running_loop()
+        deadline = time.monotonic() + wait_ms / 1000.0
+        while True:
+            out = await loop.run_in_executor(
+                None,
+                read_wal_from,
+                self.store.wal_dir, segment, offset, max_records,
+            )
+            if (
+                out["records"]
+                or out["reset"]
+                or not out["eof"]
+                or time.monotonic() >= deadline
+            ):
+                out["leader_version"] = self.store.version
+                return web.json_response(out)
+            await asyncio.sleep(self.poll_interval_s)
+
+    def register(self, app: web.Application) -> None:
+        app.router.add_get("/replication/status", self.handle_status)
+        app.router.add_get("/replication/checkpoint", self.handle_checkpoint)
+        app.router.add_get("/replication/wal", self.handle_wal)

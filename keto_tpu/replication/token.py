@@ -1,0 +1,71 @@
+"""Structured snaptokens (Zanzibar "zookies").
+
+Historically this repo's snaptoken was the store's bare version counter
+as a decimal string — meaningful on the single node that minted it, but
+carrying nothing a *replica* could order itself against. The replicated
+read plane needs a token that names a durable log position, so a write
+now acks with::
+
+    z<version>.<wal_segment_first_version>.<byte_offset>
+
+- ``version`` — the store's monotonic write counter, the component every
+  consistency decision uses (followers replay versions in order, so
+  "replica caught up to token" is exactly ``replica.version >= version``).
+- ``wal_segment``/``offset`` — where the ack's WAL frame landed (segment
+  = the segment's first version, matching its filename; offset = byte
+  position just past the frame). Diagnostic + replication-cursor
+  material: an operator or a promotion drill can point at the durable
+  bytes behind any acked token.
+
+Tokens are opaque to clients. Bare-integer tokens (the old spelling, and
+what SQL-backed stores without a WAL still mint) parse as
+``SnapToken(version, 0, 0)`` so every existing client and test keeps
+working. Ordering is by version alone — segment/offset are tie-breaker
+metadata, never consulted for freshness decisions.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+_TOKEN_RE = re.compile(r"^z(\d+)\.(\d+)\.(\d+)$")
+
+#: min_version sentinel for `latest: true` — far above any real store
+#: version. Lives here (not api/convert.py, which re-exports it) so the
+#: follower's wait path can recognize it without importing the API layer.
+LATEST_SENTINEL = 1 << 62
+
+
+@dataclass(frozen=True)
+class SnapToken:
+    """One acked write's durable position."""
+
+    version: int
+    segment: int = 0  # first version of the WAL segment holding the frame
+    offset: int = 0  # byte offset just past the frame in that segment
+
+    def encode(self) -> str:
+        return f"z{self.version}.{self.segment}.{self.offset}"
+
+    def __str__(self) -> str:  # registry snaptoken fns return str(token)
+        return self.encode()
+
+
+def encode_snaptoken(
+    version: int, segment: int = 0, offset: int = 0
+) -> str:
+    return SnapToken(int(version), int(segment), int(offset)).encode()
+
+
+def parse_snaptoken(token: str) -> SnapToken:
+    """Parse either spelling; raises ``ValueError`` on anything else (the
+    API layer maps that to a 400, exactly like the old bare-int parse)."""
+    m = _TOKEN_RE.match(token)
+    if m is not None:
+        return SnapToken(
+            version=int(m.group(1)),
+            segment=int(m.group(2)),
+            offset=int(m.group(3)),
+        )
+    return SnapToken(version=int(token))

@@ -245,6 +245,18 @@ class DurableTupleStore:
     def version(self) -> int:
         return self.inner.version
 
+    def current_token(self):
+        """The zookie for the newest acked write: the store version plus
+        the WAL position its frame ended at. Reading version first and
+        position second keeps the pair conservative under concurrent
+        writes (the offset may already include a NEWER frame, never an
+        older one — a token must never under-promise durability)."""
+        from ..replication.token import SnapToken
+
+        version = self.inner.version
+        segment, offset = self.wal.position()
+        return SnapToken(version=version, segment=segment, offset=offset)
+
     # -- capture + logging -----------------------------------------------------
 
     def _capture(self, version, inserted, deleted) -> None:

@@ -168,6 +168,41 @@ class InMemoryTupleStore(OrderedNotifier, Manager):
             self._enqueue_notification(v, inserted=fresh, deleted=gone)
         self._drain_notifications(upto=v)
 
+    # -- replication ----------------------------------------------------------
+
+    def apply_replicated_delta(
+        self,
+        version: int,
+        inserted: Sequence[RelationTuple],
+        deleted: Sequence[RelationTuple],
+    ) -> bool:
+        """Apply one leader-shipped delta at the leader's version number.
+
+        Unlike boot-time WAL replay (store/durable.py ``_apply_record``)
+        this runs while the store is LIVE on a follower, so it goes
+        through the ordered-notification path — the snapshot layer sees
+        the delta exactly as it would a local write. Validation is
+        skipped on purpose: the delta already passed it on the leader.
+        Returns False (no-op) for versions at or below the current one —
+        replay after a reconnect may resend the overlap."""
+        with self._lock:
+            if version <= self._version:
+                return False
+            fresh = []
+            for t in inserted:
+                if t not in self._tuples:
+                    self._tuples[t] = self._seq
+                    self._seq += 1
+                    fresh.append(t)
+            gone = []
+            for t in deleted:
+                if self._tuples.pop(t, None) is not None:
+                    gone.append(t)
+            self._version = version
+            self._enqueue_notification(version, inserted=fresh, deleted=gone)
+        self._drain_notifications(upto=version)
+        return True
+
     # -- snapshot support -----------------------------------------------------
 
     def all_tuples(self) -> list[RelationTuple]:

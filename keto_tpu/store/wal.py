@@ -160,8 +160,9 @@ def _fsync_dir(directory: str) -> None:
         os.close(fd)
 
 
-def _parse_payload(payload: bytes) -> WalRecord:
-    doc = json.loads(payload.decode("utf-8"))
+def record_from_doc(doc: dict) -> WalRecord:
+    """Decode one frame's JSON document (also the unit the replication
+    plane ships leader -> follower, so both ends share one decoder)."""
     if doc.get("k") == "b":
         return WalRecord(version=int(doc["v"]), inserted=[], deleted=[],
                          kind="bulk")
@@ -170,6 +171,10 @@ def _parse_payload(payload: bytes) -> WalRecord:
         inserted=[decode_tuple(r) for r in doc.get("i", ())],
         deleted=[decode_tuple(r) for r in doc.get("d", ())],
     )
+
+
+def _parse_payload(payload: bytes) -> WalRecord:
+    return record_from_doc(json.loads(payload.decode("utf-8")))
 
 
 def _scan_segment(path: str, final: bool, stats: ReplayStats):
@@ -264,6 +269,7 @@ class WriteAheadLog:
         self._lock = threading.Lock()
         self._f = None
         self._seg_size = 0
+        self._seg_first = 0  # first version of the active tail segment
         self._last_sync = 0.0
         self.appended_records = 0
         self.synced_records = 0
@@ -271,12 +277,13 @@ class WriteAheadLog:
         segs = _list_segments(directory)
         if segs:
             # adopt the tail segment: truncate any torn suffix, then append
-            _first, path = segs[-1]
+            first, path = segs[-1]
             stats = ReplayStats()
             _records, valid_end = _scan_segment(path, final=True, stats=stats)
             with open(path, "r+b") as f:
                 f.truncate(max(valid_end, 0))
             self._open_segment(path, fresh=False)
+            self._seg_first = first
         # else: first append creates wal-<version>.seg lazily
 
     # -- internals -------------------------------------------------------------
@@ -300,6 +307,7 @@ class WriteAheadLog:
         self._open_segment(
             _segment_path(self.directory, next_version), fresh=True
         )
+        self._seg_first = next_version
 
     def _sync_locked(self) -> None:
         if self._f is None:
@@ -379,6 +387,15 @@ class WriteAheadLog:
         with self._lock:
             if self._f is not None:
                 self._sync_locked()
+
+    def position(self) -> tuple[int, int]:
+        """(active segment's first version, byte size of its valid
+        prefix) — the durable cursor a snaptoken embeds. ``(0, 0)``
+        before the first append creates a segment."""
+        with self._lock:
+            if self._f is None:
+                return 0, 0
+            return self._seg_first, self._seg_size
 
     def _check_open(self) -> None:
         if self.directory is None:

@@ -240,6 +240,7 @@ class CheckTelemetry:
         slow_s: float = 0.25,
         stages_fn=None,
         attribution=None,
+        role: str = "",
     ):
         self.tracer = tracer
         self.flight = flight
@@ -247,6 +248,10 @@ class CheckTelemetry:
         self.slow_s = float(slow_s)
         self.stages_fn = stages_fn
         self.attribution = attribution
+        # replication role ("leader"/"follower", "" standalone): stamped
+        # on flight records so /debug/flight distinguishes which node a
+        # slow or lag-bounced check was served by
+        self.role = str(role or "")
         self._hist = None
         self._outcomes = None
         if metrics is not None:
@@ -333,6 +338,7 @@ class CheckTelemetry:
         rec = {
             "trace_id": tid_hex or None,
             "transport": transport,
+            "role": self.role or None,
             "outcome": outcome,
             "slow": slow,
             "duration_ms": round(duration_s * 1000.0, 3),

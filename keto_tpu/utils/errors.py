@@ -95,6 +95,51 @@ class ErrUnavailable(KetoError):
     grpc_code = "UNAVAILABLE"
 
 
+class ErrFollowerLag(ErrUnavailable):
+    """A follower could not catch up to the requested snaptoken within the
+    freshness window. Retryable: the response carries the follower's
+    current lag so the caller can back off or re-route to the leader."""
+
+    retry_after_s = 1
+
+    def __init__(
+        self,
+        message: str | None = None,
+        *,
+        lag_versions: int = 0,
+        lag_seconds: float = 0.0,
+        retry_after_s: float | None = None,
+    ):
+        self.lag_versions = int(lag_versions)
+        self.lag_seconds = float(lag_seconds)
+        if retry_after_s is not None:
+            self.retry_after_s = retry_after_s
+        super().__init__(message)
+
+    def default_message(self) -> str:
+        return (
+            "The follower replica is behind the requested snaptoken "
+            f"(lag: {self.lag_versions} versions); retry or route to "
+            "the leader."
+        )
+
+    def envelope(self) -> dict:
+        doc = super().envelope()
+        doc["error"]["details"] = {
+            "lag_versions": self.lag_versions,
+            "lag_seconds": round(self.lag_seconds, 3),
+        }
+        return doc
+
+
+class ErrReadOnlyFollower(ErrUnavailable):
+    """A mutation reached a follower replica. Followers serve the read
+    plane only — the client must write to the leader endpoint."""
+
+    def default_message(self) -> str:
+        return "This replica is a read-only follower; write to the leader."
+
+
 class DeadlineExceeded(KetoError):
     """The caller's deadline passed before (or while) the request was
     served. Distinct from :class:`ErrUnavailable`: the server was healthy,

@@ -1,0 +1,325 @@
+"""Snaptoken (zookie) contract + the replicated read plane.
+
+Three layers, cheapest first:
+
+- token algebra: the ``z<version>.<segment>.<offset>`` spelling round-
+  trips, bare-int legacy tokens keep parsing, garbage raises;
+- write-ack monotonicity across every persistence backend (the store
+  matrix fixture) and structured-token minting on the durable store;
+- the follower's consistency surface: ``wait_for_version`` honoring the
+  freshness window (bounce at zero, wait inside it, typed ErrFollowerLag
+  with real lag numbers past it), the LATEST sentinel resolving against
+  the leader's position, and a live leader->follower pair over the real
+  /replication HTTP routes (checkpoint bootstrap + WAL tail replay).
+
+The SIGKILL-the-leader promotion drill lives in tools/soak.py
+(--restart) and the 1-leader/2-follower registry-level cluster in
+tools/replication_gate.py; both run as tools/check.sh gates.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from keto_tpu.engine.qos import NamespaceQos, QosThrottled
+from keto_tpu.relationtuple.definitions import RelationTuple, SubjectID
+from keto_tpu.replication.follower import FollowerReplicator
+from keto_tpu.replication.token import (
+    LATEST_SENTINEL,
+    SnapToken,
+    encode_snaptoken,
+    parse_snaptoken,
+)
+from keto_tpu.store import InMemoryTupleStore
+from keto_tpu.utils.errors import ErrFollowerLag, ErrReadOnlyFollower
+
+
+def _tup(i: int) -> RelationTuple:
+    return RelationTuple(
+        namespace="n", object=f"o{i}", relation="view",
+        subject=SubjectID(id="alice"),
+    )
+
+
+# -- token algebra ------------------------------------------------------------
+
+
+def test_token_roundtrip():
+    t = SnapToken(7, 3, 1200)
+    assert t.encode() == "z7.3.1200"
+    assert parse_snaptoken("z7.3.1200") == t
+    assert str(t) == t.encode()
+    assert encode_snaptoken(9) == "z9.0.0"
+
+
+def test_bare_int_tokens_still_parse():
+    # the pre-replication spelling (and what WAL-less SQL stores mint)
+    assert parse_snaptoken("42") == SnapToken(42, 0, 0)
+    assert parse_snaptoken("0") == SnapToken(0, 0, 0)
+
+
+@pytest.mark.parametrize(
+    "bad", ["", "z1.2", "zx.y.z", "not-a-token", "z-1.0.0", "1.2.3"]
+)
+def test_garbage_tokens_raise(bad):
+    with pytest.raises(ValueError):
+        parse_snaptoken(bad)
+
+
+def test_ordering_is_by_version_alone():
+    # segment/offset are diagnostic cursor material, never freshness
+    newer = parse_snaptoken("z5.1.10")
+    older = parse_snaptoken("z4.9.99999")
+    assert newer.version > older.version
+
+
+# -- write-ack monotonicity ---------------------------------------------------
+
+
+def test_write_ack_tokens_monotonic_across_backends(store, nsmgr):
+    nsmgr.add("n")
+    versions = []
+    for i in range(6):
+        store.write_relation_tuples(_tup(i))
+        current_token = getattr(store, "current_token", None)
+        token = (
+            str(current_token())
+            if current_token is not None
+            else str(store.version)
+        )
+        versions.append(parse_snaptoken(token).version)
+    assert versions == sorted(versions)
+    assert len(set(versions)) == len(versions), "acks must be strict"
+
+
+def test_durable_store_mints_structured_tokens(tmp_path):
+    from keto_tpu.store import DurableTupleStore
+
+    s = DurableTupleStore(
+        InMemoryTupleStore(), str(tmp_path / "wal"), sync="always"
+    )
+    try:
+        tokens = []
+        for i in range(4):
+            s.write_relation_tuples(_tup(i))
+            tokens.append(parse_snaptoken(str(s.current_token())))
+        assert [t.version for t in tokens] == [1, 2, 3, 4]
+        # every ack names durable bytes: a real segment, advancing offsets
+        assert all(t.segment >= 1 for t in tokens)
+        offsets = [t.offset for t in tokens]
+        assert offsets == sorted(offsets) and len(set(offsets)) == 4
+    finally:
+        s.close_durable()
+
+
+# -- follower waits: the two consistency modes --------------------------------
+
+
+def _follower(tmp_path, store=None, **kw):
+    return FollowerReplicator(
+        store if store is not None else InMemoryTupleStore(),
+        "http://127.0.0.1:1",  # never dialed in the wait-only tests
+        scratch_dir=str(tmp_path / "scratch"),
+        **kw,
+    )
+
+
+def test_zero_window_bounces_with_lag_details(tmp_path):
+    rep = _follower(tmp_path)
+    rep.leader_version = 5
+    with pytest.raises(ErrFollowerLag) as ei:
+        rep.wait_for_version(5, timeout_s=0.0)
+    assert ei.value.lag_versions == 5
+    assert ei.value.retry_after_s >= 1
+    details = ei.value.envelope()["error"]["details"]
+    assert details["lag_versions"] == 5
+
+
+def test_wait_honors_the_freshness_window(tmp_path):
+    rep = _follower(tmp_path)
+    rep.leader_version = 3
+    t0 = time.monotonic()
+    with pytest.raises(ErrFollowerLag):
+        rep.wait_for_version(3, timeout_s=0.3)
+    elapsed = time.monotonic() - t0
+    assert 0.25 <= elapsed < 3.0, elapsed
+
+
+def test_wait_returns_once_replay_passes_the_token(tmp_path):
+    store = InMemoryTupleStore()
+    rep = _follower(tmp_path, store)
+    rep.leader_version = 1
+
+    def catch_up():
+        time.sleep(0.05)
+        store.apply_replicated_delta(1, [_tup(1)], [])
+        with rep._cv:
+            rep._cv.notify_all()
+
+    threading.Thread(target=catch_up, daemon=True).start()
+    assert rep.wait_for_version(1, timeout_s=5.0) == 1
+
+
+def test_latest_sentinel_resolves_to_leader_position(tmp_path):
+    store = InMemoryTupleStore()
+    rep = _follower(tmp_path, store)
+    rep.leader_version = 2
+    store.apply_replicated_delta(1, [_tup(1)], [])
+    store.apply_replicated_delta(2, [_tup(2)], [])
+    assert rep.wait_for_version(LATEST_SENTINEL, timeout_s=0.0) == 2
+    # behind the leader, a zero-window latest read bounces
+    rep.leader_version = 3
+    with pytest.raises(ErrFollowerLag):
+        rep.wait_for_version(LATEST_SENTINEL, timeout_s=0.0)
+
+
+def test_read_only_follower_error_contract():
+    e = ErrReadOnlyFollower()
+    assert "read-only follower" in str(e)
+    assert "leader" in e.envelope()["error"]["message"]
+
+
+# -- live leader -> follower over the real HTTP routes ------------------------
+
+
+@pytest.fixture
+def leader_http(tmp_path):
+    """A durable store serving the three /replication routes on a bare
+    aiohttp app — the leader's replication half without the engine
+    stack (the registry-level cluster is tools/replication_gate.py)."""
+    from aiohttp import web
+
+    from keto_tpu.replication.leader import ReplicationSource
+    from keto_tpu.store import DurableTupleStore
+
+    store = DurableTupleStore(
+        InMemoryTupleStore(), str(tmp_path / "wal"), sync="always"
+    )
+    src = ReplicationSource(store, poll_interval_s=0.01)
+    app = web.Application()
+    src.register(app)
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+
+    async def serve():
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        return runner, site._server.sockets[0].getsockname()[1]
+
+    runner, port = asyncio.run_coroutine_threadsafe(
+        serve(), loop
+    ).result(timeout=60)
+    yield store, port
+    asyncio.run_coroutine_threadsafe(runner.cleanup(), loop).result(
+        timeout=10
+    )
+    loop.call_soon_threadsafe(loop.stop)
+    thread.join(timeout=5)
+
+
+def test_follower_bootstraps_from_checkpoint_and_tails(
+    leader_http, tmp_path
+):
+    store, port = leader_http
+    for i in range(5):
+        store.write_relation_tuples(_tup(i))
+
+    rep = FollowerReplicator(
+        InMemoryTupleStore(),
+        f"http://127.0.0.1:{port}",
+        scratch_dir=str(tmp_path / "f1"),
+        poll_interval_s=0.01,
+    )
+    seeded = rep.bootstrap()
+    # the leader cuts a checkpoint on demand: the follower seeds from
+    # it, not from replaying history
+    assert seeded["seeded_version"] == 5
+    assert rep.store.version == 5
+
+    # live tail: new leader writes arrive through poll_once replay
+    for i in range(5, 8):
+        store.write_relation_tuples(_tup(i))
+    deadline = time.monotonic() + 30
+    while rep.store.version < 8 and time.monotonic() < deadline:
+        rep.poll_once(wait_ms=200)
+    assert rep.store.version == 8
+    assert {t.object for t in rep.store.all_tuples()} == {
+        f"o{i}" for i in range(8)
+    }
+    assert rep.applied_total >= 3
+    assert rep.lag_versions() == 0
+
+    # the ack token the leader minted is now servable here...
+    token = parse_snaptoken(str(store.current_token()))
+    assert rep.wait_for_version(token.version, timeout_s=0.0) == 8
+    # ...and a token from the future bounces inside the window
+    with pytest.raises(ErrFollowerLag):
+        rep.wait_for_version(token.version + 1, timeout_s=0.05)
+
+
+def test_follower_reseeds_when_cursor_is_pruned(leader_http, tmp_path):
+    store, port = leader_http
+    for i in range(3):
+        store.write_relation_tuples(_tup(i))
+    rep = FollowerReplicator(
+        InMemoryTupleStore(),
+        f"http://127.0.0.1:{port}",
+        scratch_dir=str(tmp_path / "f2"),
+        poll_interval_s=0.01,
+    )
+    rep.bootstrap()
+    # point the cursor at a segment that never existed: the leader
+    # answers reset and the follower re-seeds from a fresh checkpoint
+    rep._cursor = [999999, 0]
+    store.write_relation_tuples(_tup(99))
+    rep.poll_once()
+    assert rep.reseeds_total == 1
+    assert rep._cursor == [0, 0]
+    deadline = time.monotonic() + 30
+    while rep.store.version < 4 and time.monotonic() < deadline:
+        rep.poll_once(wait_ms=200)
+    assert rep.store.version == 4
+
+
+# -- per-tenant QoS -----------------------------------------------------------
+
+
+def test_qos_throttles_per_namespace_not_globally():
+    clock = [0.0]
+    qos = NamespaceQos(rate=10.0, burst=5.0, clock=lambda: clock[0])
+    for _ in range(5):
+        qos.admit("hot")
+    with pytest.raises(QosThrottled) as ei:
+        qos.admit("hot")
+    assert ei.value.namespace == "hot"
+    assert ei.value.retry_after_s >= 1
+    qos.admit("cold")  # another tenant's bucket is untouched
+    clock[0] += 1.0  # refill: 10 tokens/s against a 5-token burst cap
+    qos.admit("hot", 5)
+
+
+def test_qos_overrides_and_unlimited_default():
+    qos = NamespaceQos(
+        rate=0.0,  # default: admit everything
+        burst=100.0,
+        overrides={"metered": {"rate": 1.0, "burst": 1.0}},
+        clock=lambda: 0.0,
+    )
+    for _ in range(1000):
+        qos.admit("free")
+    qos.admit("metered")
+    with pytest.raises(QosThrottled):
+        qos.admit("metered")
+    assert qos.stats()["overrides"]["metered"]["rate"] == 1.0
+
+
+def test_qos_batch_admission_is_per_namespace_counts():
+    qos = NamespaceQos(rate=10.0, burst=10.0, clock=lambda: 0.0)
+    qos.admit_counts({"a": 6, "b": 6})  # separate buckets: both fit
+    with pytest.raises(QosThrottled):
+        qos.admit_counts({"a": 6})  # a's bucket only has 4 left

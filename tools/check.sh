@@ -16,10 +16,14 @@
 #      futures, bounded p99; plus the kill-and-restart drill (SIGKILL at
 #      every WAL/checkpoint fault site, post-recovery parity vs a shadow
 #      oracle)
-#   4. metrics lint — boot the serving stack, drive traffic, scrape
+#   4. replication gate — 1 leader + 2 followers in-process: checkpoint
+#      bootstrap + WAL-tail convergence under a lag bound, token-
+#      consistent reads on followers (wait AND bounce paths), read-only
+#      follower write plane, replication metrics exported
+#   5. metrics lint — boot the serving stack, drive traffic, scrape
 #      /metrics from both planes in Prometheus-text and OpenMetrics
 #      formats, and fail on naming/duplicate-series/format violations
-#   5. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
+#   6. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
 #
 # Usage: bash tools/check.sh            (from the repo root)
 set -o pipefail
@@ -33,6 +37,9 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python bench.py --smoke || exit 1
 
 echo "== chaos soak smoke =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/soak.py --smoke --seed 4 --pool --restart || exit 1
+
+echo "== replication gate =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/replication_gate.py || exit 1
 
 echo "== metrics lint =="
 timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/lint_metrics.py || exit 1

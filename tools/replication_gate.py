@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""CI gate for the replicated read plane: 1 leader + 2 followers,
+in-process, through the full Registry stack.
+
+What must hold (the tools/check.sh tier):
+
+- the leader (memory DSN + WAL) serves the /replication routes on its
+  write plane and mints structured ``z<v>.<seg>.<off>`` ack tokens;
+- both followers bootstrap from the leader's checkpoint, tail its WAL,
+  and CONVERGE on every leader write within the lag bound;
+- token-consistent reads work on followers in both modes: the WAIT path
+  (a just-minted token answers 200 inside the freshness window) and the
+  BOUNCE path (an unreachable token under a tight deadline answers 503
+  with Retry-After + structured lag details);
+- follower write planes reject mutations (read-only follower contract);
+- replication lag/staleness metrics are exported on follower /metrics;
+- the snaptoken-aware multi-endpoint client routes checks across both
+  followers and returns the right answers.
+
+Exit 0 with a one-line summary JSON on stdout; exit 1 with the
+violation list otherwise.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import httpx  # noqa: E402
+
+from keto_tpu.driver import Config, Registry  # noqa: E402
+
+LAG_BOUND_S = 10.0  # follower convergence bound for in-process localhost
+
+
+class _Node:
+    """One Registry on its own event-loop thread (HTTP is issued from
+    the MAIN thread — blocking calls on a serving loop deadlock it)."""
+
+    def __init__(self, values: dict):
+        self.registry = Registry(Config(values=values))
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(
+            target=self.loop.run_forever, daemon=True
+        )
+        self.thread.start()
+        self.read_port, self.write_port = asyncio.run_coroutine_threadsafe(
+            self.registry.start_all(), self.loop
+        ).result(timeout=180)
+
+    def stop(self) -> None:
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self.registry.stop_all(), self.loop
+            ).result(timeout=30)
+        finally:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self.thread.join(timeout=5)
+
+
+def _base(extra: dict) -> dict:
+    return {
+        "namespaces": [{"id": 1, "name": "n"}],
+        "log": {"level": "error"},
+        "engine": {"mode": "host"},
+        "serve": {
+            "read": {"port": 0, "host": "127.0.0.1"},
+            "write": {"port": 0, "host": "127.0.0.1"},
+        },
+        **extra,
+    }
+
+
+def _params(obj: str) -> dict:
+    return {
+        "namespace": "n", "object": obj, "relation": "view",
+        "subject_id": "alice",
+    }
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    violations: list[str] = []
+    root = tempfile.mkdtemp(prefix="keto-replgate-")
+    nodes: list[_Node] = []
+    http = httpx.Client(timeout=60)
+    try:
+        leader = _Node(
+            _base(
+                {
+                    "dsn": "memory",
+                    "store": {"wal": {"dir": os.path.join(root, "wal")}},
+                    "replication": {"role": "leader", "poll_interval_ms": 10},
+                }
+            )
+        )
+        nodes.append(leader)
+
+        def put(obj: str) -> None:
+            r = http.put(
+                f"http://127.0.0.1:{leader.write_port}/relation-tuples",
+                json={
+                    "namespace": "n", "object": obj, "relation": "view",
+                    "subject_id": "alice",
+                },
+            )
+            if r.status_code != 201:
+                violations.append(f"leader write {obj}: {r.status_code}")
+
+        # seed writes land in the bootstrap checkpoint; later ones only
+        # reach followers over the WAL tail
+        for i in range(10):
+            put(f"seed{i}")
+        token_seed = leader.registry.snaptoken()
+        if not token_seed.startswith("z"):
+            violations.append(
+                f"leader minted a non-structured token: {token_seed!r}"
+            )
+
+        upstream = f"http://127.0.0.1:{leader.write_port}"
+        followers = []
+        for i in range(2):
+            followers.append(
+                _Node(
+                    _base(
+                        {
+                            "dsn": "memory",
+                            "replication": {
+                                "role": "follower",
+                                "upstream": upstream,
+                                "dir": os.path.join(root, f"f{i}"),
+                                "poll_interval_ms": 10,
+                            },
+                        }
+                    )
+                )
+            )
+        nodes.extend(followers)
+
+        for i in range(10, 20):
+            put(f"tail{i}")
+        token_tail = leader.registry.snaptoken()
+
+        # -- convergence under the lag bound --------------------------------
+        deadline = time.monotonic() + LAG_BOUND_S
+        for fi, f in enumerate(followers):
+            while True:
+                r = http.get(
+                    f"http://127.0.0.1:{f.read_port}/check",
+                    params={**_params("tail19"), "snaptoken": token_tail},
+                )
+                if r.status_code == 200 and r.json().get("allowed"):
+                    break
+                if time.monotonic() > deadline:
+                    violations.append(
+                        f"follower {fi} did not converge to {token_tail} "
+                        f"within {LAG_BOUND_S}s (last: {r.status_code})"
+                    )
+                    break
+                time.sleep(0.05)
+
+        # -- WAIT path: a just-minted token answers inside the window -------
+        put("fresh-write")
+        token_fresh = leader.registry.snaptoken()
+        for fi, f in enumerate(followers):
+            r = http.get(
+                f"http://127.0.0.1:{f.read_port}/check",
+                params={
+                    **_params("fresh-write"), "snaptoken": token_fresh,
+                },
+            )
+            if not (r.status_code == 200 and r.json().get("allowed")):
+                violations.append(
+                    f"follower {fi} wait-path read failed: "
+                    f"{r.status_code} {r.text[:120]}"
+                )
+
+        # -- BOUNCE path: unreachable token + tight deadline -> 503 + lag ---
+        r = http.get(
+            f"http://127.0.0.1:{followers[0].read_port}/check",
+            params={
+                **_params("fresh-write"), "snaptoken": "z99999999.0.0",
+            },
+            headers={"X-Request-Deadline-Ms": "50"},
+        )
+        if r.status_code != 503:
+            violations.append(f"bounce path answered {r.status_code}")
+        else:
+            if "Retry-After" not in r.headers:
+                violations.append("bounce response lacks Retry-After")
+            details = (r.json().get("error") or {}).get("details") or {}
+            if "lag_versions" not in details:
+                violations.append(
+                    f"bounce response lacks lag details: {r.text[:200]}"
+                )
+
+        # -- read-only follower write plane ---------------------------------
+        r = http.put(
+            f"http://127.0.0.1:{followers[1].write_port}/relation-tuples",
+            json={
+                "namespace": "n", "object": "x", "relation": "view",
+                "subject_id": "alice",
+            },
+        )
+        if r.status_code != 503 or "read-only" not in r.text:
+            violations.append(
+                f"follower accepted a write: {r.status_code} {r.text[:120]}"
+            )
+
+        # -- replication metrics exported -----------------------------------
+        metrics = http.get(
+            f"http://127.0.0.1:{followers[0].read_port}/metrics"
+        ).text
+        for name in (
+            "keto_replication_lag_versions",
+            "keto_replication_lag_seconds",
+            "keto_replication_staleness_seconds",
+            "keto_replication_applied_total",
+        ):
+            if name not in metrics:
+                violations.append(f"follower /metrics lacks {name}")
+
+        # -- snaptoken-aware multi-endpoint client across both followers ----
+        from keto_tpu.client import ReplicatedRestClient
+
+        with ReplicatedRestClient(
+            [f"http://127.0.0.1:{f.read_port}" for f in followers],
+            write_url=f"http://127.0.0.1:{leader.write_port}",
+        ) as client:
+            for _ in range(6):  # round-robins across both followers
+                res = client.check(
+                    "n:fresh-write#view@alice", snaptoken=token_fresh
+                )
+                if not res.allowed:
+                    violations.append("routed client got a wrong answer")
+                    break
+            routed = client.router.snapshot()
+            if all(v["known_version"] == 0 for v in routed.values()):
+                violations.append(
+                    f"router learned nothing from routed reads: {routed}"
+                )
+
+        lag_panels = [
+            f.registry.replicator().lag() for f in followers
+        ]
+        summary = {
+            "ok": not violations,
+            "leader_token": token_tail,
+            "followers": [
+                {
+                    "version": p["version"],
+                    "lag_versions": p["lag_versions"],
+                    "applied_total": p["applied_total"],
+                }
+                for p in lag_panels
+            ],
+            "elapsed_s": round(time.monotonic() - t0, 2),
+            "violations": violations,
+        }
+        print(json.dumps(summary))
+        if violations:
+            for v in violations:
+                print(f"  - {v}", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        http.close()
+        for node in nodes:
+            try:
+                node.stop()
+            except Exception as e:  # noqa: BLE001
+                print(f"node stop failed: {e!r}", file=sys.stderr)
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -158,11 +158,114 @@ def _restart_child_main(spec_raw: str) -> int:
     return 0
 
 
+def _promotion_child_main(spec_raw: str) -> int:
+    """Leader process of the SIGKILL-promotion drill (--restart): a
+    durable store plus the real replication routes (ReplicationSource on
+    a bare aiohttp app — no engine stack, same import-tax discipline as
+    the restart child). Protocol: write a prefix, cut a checkpoint (the
+    follower must seed from a real checkpoint), print ``ready`` with the
+    port, wait for ``go`` on stdin (the parent's follower has
+    bootstrapped), then stream single-writer ops with INTENT/ACK lines —
+    and SIGKILL ourselves right after the ``kill_at`` write lands
+    durably but BEFORE its ack, the durable-but-unacked edge promotion
+    must surface."""
+    import asyncio
+    import signal
+
+    from aiohttp import web
+
+    spec = json.loads(spec_raw)
+    from keto_tpu.relationtuple.definitions import (
+        RelationTuple,
+        SubjectID,
+    )
+    from keto_tpu.replication.leader import ReplicationSource
+    from keto_tpu.store import DurableTupleStore, InMemoryTupleStore
+    from keto_tpu.store.wal import encode_tuple
+
+    def emit(obj) -> None:
+        print(json.dumps(obj), flush=True)
+
+    store = DurableTupleStore(
+        InMemoryTupleStore(),
+        spec["dir"],
+        sync="always",  # WAL-before-ack: the invariant under test
+        checkpoint_interval_versions=10**9,
+        checkpoint_interval_s=0.0,
+    )
+    rng = random.Random(int(spec["seed"]) * 104729)
+    ops = int(spec["ops"])
+    kill_at = int(spec["kill_at"])
+
+    def write_op(i: int) -> None:
+        t = RelationTuple(
+            namespace="n", object=f"promo{i}", relation="view",
+            subject=SubjectID(id=f"u{rng.randrange(5)}"),
+        )
+        emit({"op": i, "t": encode_tuple(t)})
+        store.write_relation_tuples(t)
+
+    prefix = max(1, ops // 3)
+    for i in range(prefix):
+        write_op(i)
+        emit(
+            {
+                "ack": i,
+                "version": store.version,
+                "token": str(store.current_token()),
+            }
+        )
+    store.checkpoint_now()
+
+    src = ReplicationSource(store, poll_interval_s=0.01)
+    app = web.Application()
+    src.register(app)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    async def _serve() -> int:
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        return site._server.sockets[0].getsockname()[1]
+
+    port = asyncio.run_coroutine_threadsafe(_serve(), loop).result(
+        timeout=60
+    )
+    emit({"ready": True, "port": port, "version": store.version})
+    sys.stdin.readline()  # parent's follower has seeded: start streaming
+
+    for i in range(prefix, ops):
+        write_op(i)
+        if i == kill_at:
+            # the frame is on disk (sync=always) but the ack never
+            # leaves: recovery surfacing exactly this op is correct
+            os.kill(os.getpid(), signal.SIGKILL)
+        emit(
+            {
+                "ack": i,
+                "version": store.version,
+                "token": str(store.current_token()),
+            }
+        )
+        time.sleep(0.01)  # let the follower tail live traffic
+    emit({"done": True})
+    return 0
+
+
 if "--restart-child" in sys.argv:
     # handled BEFORE the keto_tpu.driver import below: the child only
     # needs the store layer, not the engine stack
     sys.exit(
         _restart_child_main(sys.argv[sys.argv.index("--restart-child") + 1])
+    )
+
+if "--promotion-child" in sys.argv:
+    sys.exit(
+        _promotion_child_main(
+            sys.argv[sys.argv.index("--promotion-child") + 1]
+        )
     )
 
 from keto_tpu.driver import Config, Registry  # noqa: E402
@@ -808,6 +911,158 @@ def run_restart_drill(seed: int, ops_per_cycle: int = 40) -> dict:
     }
 
 
+def run_promotion_drill(seed: int, ops: int = 60) -> dict:
+    """SIGKILL-the-leader drill: a follower seeded from the leader's
+    checkpoint and tailing its WAL gets promoted off the dead leader's
+    log (shared-disk failover) and must hold EVERY acked write.
+
+    The leader child acks each write only after its WAL frame is durable
+    (sync=always) and kills itself mid-stream right after one durable-
+    but-unacked write — so the drill asserts the full WAL-before-ack
+    contract: zero acked writes lost, ack tokens monotonic, at most the
+    one unacked op surfacing as a recovered extra, and the promoted node
+    serving at-least-latest reads with no residual lag."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from keto_tpu.replication.follower import FollowerReplicator
+    from keto_tpu.replication.token import parse_snaptoken
+    from keto_tpu.store import InMemoryTupleStore
+    from keto_tpu.store.wal import encode_tuple
+
+    t0 = time.monotonic()
+    viol = _Violations()
+    root = tempfile.mkdtemp(prefix="keto-promotion-")
+    wal_dir = os.path.join(root, "wal")
+    scratch = os.path.join(root, "follower")
+    rng = random.Random(seed + 31)
+    kill_at = rng.randrange((ops * 2) // 3, ops - 2)
+    spec = {"dir": wal_dir, "ops": ops, "seed": seed, "kill_at": kill_at}
+    follower = None
+    proc = None
+    summary = {"phase": "promotion", "seed": seed, "kill_at": kill_at}
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--promotion-child", json.dumps(spec)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        lines: list[dict] = []
+
+        def _take(raw: str):
+            try:
+                doc = json.loads(raw)
+            except json.JSONDecodeError:
+                viol.add(f"promotion: undecodable child line {raw!r}")
+                return None
+            lines.append(doc)
+            return doc
+
+        port = None
+        for raw in proc.stdout:
+            doc = _take(raw)
+            if doc and doc.get("ready"):
+                port = doc["port"]
+                break
+        if port is None:
+            err = proc.stderr.read()[-400:] if proc.stderr else ""
+            viol.add(f"promotion: leader child never became ready ({err!r})")
+            return {**summary, "violations": viol.items}
+
+        follower = FollowerReplicator(
+            InMemoryTupleStore(),
+            f"http://127.0.0.1:{port}",
+            scratch_dir=scratch,
+            poll_interval_s=0.01,
+            wait_ms=200.0,
+        )
+        follower.start()
+        if follower.store.version <= 0:
+            viol.add(
+                "promotion: follower did not seed from the leader's "
+                "checkpoint"
+            )
+        proc.stdin.write("go\n")
+        proc.stdin.flush()
+        for raw in proc.stdout:  # drains until SIGKILL closes the pipe
+            _take(raw)
+        rc = proc.wait(timeout=60)
+        if any("done" in l for l in lines):
+            viol.add(
+                f"promotion: leader was never killed (kill_at={kill_at}, "
+                f"rc={rc})"
+            )
+
+        acked = {l["ack"] for l in lines if "ack" in l}
+        intents = {l["op"]: tuple(l["t"]) for l in lines if "op" in l}
+        oracle = {intents[i] for i in acked}  # insert-only stream
+        unacked = {intents[i] for i in intents if i not in acked}
+        versions = [l["version"] for l in lines if "ack" in l]
+        token_versions = [
+            parse_snaptoken(l["token"]).version
+            for l in lines
+            if "token" in l
+        ]
+        if token_versions != sorted(token_versions):
+            viol.add("promotion: write-ack snaptokens not monotonic")
+        if versions and token_versions and versions != token_versions:
+            viol.add("promotion: ack token version != store version")
+        tailed_live = follower.applied_total
+
+        rep = follower.promote(wal_dir)
+        if rep["gap"]:
+            viol.add("promotion: replayed leader log had gaps")
+        last_ack = versions[-1] if versions else 0
+        if rep["final_version"] < last_ack:
+            viol.add(
+                f"promotion: final version {rep['final_version']} < last "
+                f"acked {last_ack} — acked writes lost"
+            )
+        got = {
+            tuple(encode_tuple(t)) for t in follower.store.all_tuples()
+        }
+        lost = oracle - got
+        if lost:
+            viol.add(
+                f"promotion: {len(lost)} acked writes missing after "
+                "promotion"
+            )
+        phantom = got - oracle - unacked
+        if phantom:
+            viol.add(
+                f"promotion: {len(phantom)} phantom tuples after promotion"
+            )
+        try:
+            # a promoted node is the authority: zero-window at-least-
+            # latest reads must pass with no residual lag
+            follower.wait_for_version(rep["final_version"], timeout_s=0.0)
+        except KetoError as e:
+            viol.add(f"promotion: promoted node still lagging: {e!r}")
+        if follower.role != "leader":
+            viol.add(f"promotion: role is {follower.role!r} after promote")
+        summary.update(
+            {
+                "acked_ops": len(acked),
+                "tailed_live": tailed_live,
+                "promote_applied": rep["applied"],
+                "final_version": rep["final_version"],
+                "elapsed_s": round(time.monotonic() - t0, 2),
+                "violations": viol.items,
+            }
+        )
+        return summary
+    finally:
+        if follower is not None:
+            follower.stop()
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=4)
@@ -848,6 +1103,9 @@ def main(argv=None) -> int:
             run_restart_drill(
                 args.seed, ops_per_cycle=40 if args.smoke else 120
             )
+        )
+        phases.append(
+            run_promotion_drill(args.seed, ops=60 if args.smoke else 150)
         )
     bad = [v for p in phases for v in p["violations"]]
     print(json.dumps({"phases": phases, "ok": not bad}, indent=2))
