@@ -269,6 +269,27 @@ def _budget_loader(tag: str, n_tuples: int, store, stage_ref: list):
     return load
 
 
+def _stage_budget_gate(
+    tag: str, n_tuples: int, store, stage_ref: list, need_s: float = 30.0
+):
+    """Between-stage budget check for the staged pool builds: at rbac100m
+    scale each stage front-loads tens of millions of rng draws before the
+    loader's first per-chunk check can fire, so an exhausted budget must be
+    caught BETWEEN stages too. Persists the partial pool (resumable at
+    ``stage_ref[0]``) and raises :class:`_BudgetExhausted` — the caller's
+    config loop records the skip, the headline carries ``truncated: true``,
+    and the run still exits 0."""
+    left = _budget_left()
+    if left > need_s:
+        return
+    _pool_cache_save(tag, n_tuples, store, stage=stage_ref[0])
+    raise _BudgetExhausted(
+        f"{tag} pool build out of budget before stage {stage_ref[0]} "
+        f"({left:.1f}s left); partial pool persisted at {len(store)} "
+        "live tuples"
+    )
+
+
 def _pool_cache_load(tag: str, n_tuples: int):
     """(ColumnarTupleStore, resume_stage) from the cache, or None on miss.
     ``resume_stage`` is ``_STAGE_COMPLETE`` for a finished pool; anything
@@ -356,6 +377,7 @@ def gen_rbac(n_tuples: int, rng: np.random.Generator):
         load = _budget_loader("rbac", n_tuples, store, stage)
 
         if stage[0] <= 0:
+            _stage_budget_gate("rbac", n_tuples, store, stage)
             # users -> groups (~40%)
             k = int(n_tuples * 0.4)
             _phase(f"rbac membership edges: {k}")
@@ -365,6 +387,7 @@ def gen_rbac(n_tuples: int, rng: np.random.Generator):
             )
             stage[0] = 1
         if stage[0] <= 1:
+            _stage_budget_gate("rbac", n_tuples, store, stage)
             # groups -> roles (~10%)
             k = int(n_tuples * 0.1)
             _phase(f"rbac group->role edges: {k}")
@@ -374,6 +397,7 @@ def gen_rbac(n_tuples: int, rng: np.random.Generator):
             )
             stage[0] = 2
         if stage[0] <= 2:
+            _stage_budget_gate("rbac", n_tuples, store, stage)
             # role hierarchy (~5%, naturally collision-capped at small
             # role counts)
             k = min(int(n_tuples * 0.05), n_roles * n_roles // 2)
@@ -386,6 +410,7 @@ def gen_rbac(n_tuples: int, rng: np.random.Generator):
         # so the store really holds >= n_tuples live tuples)
         grant_dst = _pool(list(roles) + list(groups))
         while len(store) < n_tuples:
+            _stage_budget_gate("rbac", n_tuples, store, stage)
             k = n_tuples - len(store)
             _phase(f"rbac grant edges: {k} (live={len(store)})")
             load(
@@ -430,6 +455,7 @@ def gen_github(n_tuples: int, rng: np.random.Generator):
         load = _budget_loader("github", n_tuples, store, stage)
 
         if stage[0] <= 0:
+            _stage_budget_gate("github", n_tuples, store, stage)
             # team membership (~45%)
             k = int(n_tuples * 0.45)
             load(
@@ -438,6 +464,7 @@ def gen_github(n_tuples: int, rng: np.random.Generator):
             )
             stage[0] = 1
         if stage[0] <= 1:
+            _stage_budget_gate("github", n_tuples, store, stage)
             # team nesting (~3%)
             k = int(n_tuples * 0.03)
             load(
@@ -448,6 +475,7 @@ def gen_github(n_tuples: int, rng: np.random.Generator):
         # repo permission grants (rest): 80% to teams, 20% direct
         # collaborators; top up collision losses
         while len(store) < n_tuples:
+            _stage_budget_gate("github", n_tuples, store, stage)
             k = n_tuples - len(store)
             to_team = rng.random(k) < 0.8
             dst = np.where(

@@ -15,6 +15,8 @@ Routes (all under /debug, read port only):
   classic folded stacks (feed to tools/flame.py), default JSON
   flamegraph tree + profiler stats; ?seconds=N runs an on-demand
   capture when the profiler is not already running
+- ``/debug/device``   device-fault plane: serving backend, breaker +
+  quarantined shapes, last failover timeline, HBM budget headroom
 
 Gating: ``debug.enabled: false`` hides the whole surface as 404 (the
 routes do not exist as far as a prober can tell); ``debug.token`` set
@@ -115,6 +117,7 @@ class DebugContext:
         attribution=None,
         profiler=None,
         build_phases_fn=None,
+        device_status_fn=None,
     ):
         self.config = config
         self.flight = flight
@@ -132,6 +135,10 @@ class DebugContext:
         self.attribution = attribution
         self.profiler = profiler
         self.build_phases_fn = build_phases_fn
+        # PR9 device-fault plane: zero-arg callable aggregating the
+        # serving backend, breaker/quarantine state, failover timeline,
+        # and HBM budget headroom (driver/registry.py _device_status)
+        self.device_status_fn = device_status_fn
 
 
 class DebugAPI:
@@ -148,6 +155,7 @@ class DebugAPI:
         app.router.add_get("/debug/profile", self.get_profile)
         app.router.add_get("/debug/attribution", self.get_attribution)
         app.router.add_get("/debug/pprof", self.get_pprof)
+        app.router.add_get("/debug/device", self.get_device)
 
     # -- gate -----------------------------------------------------------------
 
@@ -253,6 +261,15 @@ class DebugAPI:
                 )
             except Exception:
                 payload["closure_build_phases"] = None
+        return web.json_response(payload, dumps=_dumps)
+
+    async def get_device(self, request: web.Request) -> web.Response:
+        """Device-fault plane status: which backend is serving, quarantined
+        shapes, the last failover timeline, and HBM budget headroom — the
+        first page to pull when keto_backend_failovers_total moves."""
+        self._gate(request)
+        fn = self.ctx.device_status_fn
+        payload = fn() if fn is not None else {"backend": None}
         return web.json_response(payload, dumps=_dumps)
 
     async def get_pprof(self, request: web.Request) -> web.Response:

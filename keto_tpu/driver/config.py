@@ -248,6 +248,52 @@ CONFIG_SCHEMA = {
                     },
                     "additionalProperties": False,
                 },
+                # HBM admission control (engine/hbm.py): budget check-batch
+                # device memory BEFORE the XLA allocator sees it
+                "memory": {
+                    "type": "object",
+                    "properties": {
+                        "admission": {"type": "boolean"},
+                        # fraction of the smallest device's bytes_limit
+                        # budgeted for in-flight check batches
+                        "hbm_budget_frac": {
+                            "type": "number",
+                            "exclusiveMinimum": 0,
+                            "maximum": 1,
+                        },
+                        # starting per-row footprint guess before the model
+                        # learns from observed peak_bytes_in_use deltas
+                        "bytes_per_row": {"type": "integer", "minimum": 1},
+                    },
+                    "additionalProperties": False,
+                },
+                # runtime backend failover (driver/registry.py
+                # DeviceSupervisor): on DEVICE_LOST, probe the home
+                # platform in a killable child, hot-swap to CPU while it
+                # is gone, swap back when it answers again
+                "failover": {
+                    "type": "object",
+                    "properties": {
+                        "enabled": {"type": "boolean"},
+                        # child = subprocess probe (survives jax.devices()
+                        # hangs, BENCH_r05 style); inproc = same-process
+                        # probe for test meshes without fork headroom
+                        "probe_mode": {"enum": ["child", "inproc"]},
+                        "probe_timeout_s": {
+                            "type": "number",
+                            "exclusiveMinimum": 0,
+                        },
+                        "probe_interval_s": {
+                            "type": "number",
+                            "exclusiveMinimum": 0,
+                        },
+                        "max_backoff_s": {"type": "number", "minimum": 0},
+                        # False pins serving to the host oracle while the
+                        # home platform is gone (no jax default-device swap)
+                        "allow_cpu": {"type": "boolean"},
+                    },
+                    "additionalProperties": False,
+                },
             },
             "additionalProperties": False,
         },
@@ -422,6 +468,15 @@ DEFAULTS = {
     "engine.fallback_cooldown_ms": 1000,
     "engine.mesh.data": 1,
     "engine.mesh.edge": 0,
+    "engine.memory.admission": True,
+    "engine.memory.hbm_budget_frac": 0.8,
+    "engine.memory.bytes_per_row": 4096,
+    "engine.failover.enabled": True,
+    "engine.failover.probe_mode": "child",
+    "engine.failover.probe_timeout_s": 10.0,
+    "engine.failover.probe_interval_s": 0.5,
+    "engine.failover.max_backoff_s": 30.0,
+    "engine.failover.allow_cpu": True,
     "store.wal.dir": "",
     "store.wal.sync": "always",
     "store.wal.sync-interval-ms": 50,

@@ -28,8 +28,292 @@ from ..engine.device import DeviceCheckEngine, SnapshotExpandEngine
 from ..engine.expand import ExpandEngine
 from ..graph.snapshot import SnapshotManager
 from ..store.memory import InMemoryTupleStore
+from ..faults import FAULTS
 from ..utils.errors import ErrMalformedInput
 from .config import Config
+
+
+class DeviceSupervisor:
+    """Device-loss recovery and runtime backend failover.
+
+    The breaker (engine/fallback.py) classifies a DEVICE_LOST launch error,
+    forces its circuit open (the host oracle covers the gap), and calls
+    :meth:`notify_device_lost`. This supervisor then runs the recovery loop
+    in a daemon thread:
+
+    1. probe the home backend in a supervised, KILLABLE child — the
+       ``jax.devices()``-hang failure BENCH_r05 hit lives outside this
+       process, so a wedged probe costs a bounded timeout, never the daemon
+       (``backend.probe_hang`` drills exactly that);
+    2. on probe success: drop every device-resident artifact
+       (``engine.reset_residency()``), re-warm the kernels, collapse the
+       breaker's open window so the next batch is the half-open probe —
+       device mode resumes without a daemon restart;
+    3. on repeated probe failure: hot-swap the JAX default device to a CPU
+       fallback (when one exists), rebuild residency there, and keep
+       re-probing the home backend with exponential backoff — when it
+       comes back, swap home again.
+
+    Every transition lands in the failover timeline (served by
+    /debug/device), the flight recorder, and the
+    keto_backend_failovers_total / keto_device_recovery_seconds metrics.
+    """
+
+    _TIMELINE_CAP = 64
+
+    def __init__(
+        self,
+        engine,
+        warm_batch: int = 1,
+        enabled: bool = True,
+        probe_mode: str = "child",  # child | inproc
+        probe_timeout_s: float = 10.0,
+        probe_interval_s: float = 0.5,
+        max_backoff_s: float = 30.0,
+        allow_cpu_failover: bool = True,
+        metrics=None,
+        logger=None,
+        flight=None,
+        clock=None,
+    ):
+        import time as _time
+
+        self.engine = engine
+        self.warm_batch = max(1, int(warm_batch))
+        self.enabled = bool(enabled)
+        self.probe_mode = probe_mode
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.probe_interval_s = max(0.05, float(probe_interval_s))
+        self.max_backoff_s = max(self.probe_interval_s, float(max_backoff_s))
+        self.allow_cpu_failover = bool(allow_cpu_failover)
+        self._logger = logger
+        self._flight = flight
+        self._clock = clock or _time.monotonic
+        self._breaker = None
+        self._lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._timeline: list[dict] = []
+        self._last_recovery_s: Optional[float] = None
+        self._failovers = 0
+        try:
+            import jax
+
+            self.home_platform = jax.default_backend()
+        except Exception:
+            self.home_platform = "unknown"
+        self.backend = self.home_platform  # current serving backend
+        self._m_failovers = None
+        self._m_recovery = None
+        if metrics is not None:
+            from ..telemetry.metrics import device_failover_metrics
+
+            self._m_failovers, self._m_recovery = device_failover_metrics(
+                metrics
+            )
+
+    def bind_breaker(self, breaker) -> None:
+        """Late-bound: the registry builds the breaker after the
+        supervisor (the breaker's ctor takes the notify callback)."""
+        self._breaker = breaker
+
+    # -- event intake ----------------------------------------------------------
+
+    def notify_device_lost(self, err) -> None:
+        """Called by the breaker when a launch failed DEVICE_LOST-typed.
+        Idempotent while a recovery is already running."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                return  # recovery already in flight
+            self._failovers += 1
+            self._worker = threading.Thread(
+                target=self._recover,
+                args=(str(err), self._clock()),
+                name="device-supervisor",
+                daemon=True,
+            )
+            worker = self._worker
+        if self._m_failovers is not None:
+            self._m_failovers.inc()
+        self._event("device_lost", error=str(err))
+        worker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        worker = self._worker
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=5)
+
+    # -- recovery loop ---------------------------------------------------------
+
+    def _recover(self, error: str, t_lost: float) -> None:
+        backoff = self.probe_interval_s
+        swapped = False
+        while not self._stop.is_set():
+            ok, detail = self._probe_backend(self.home_platform)
+            self._event(
+                "probe", backend=self.home_platform, ok=ok, detail=detail
+            )
+            if ok:
+                if self._reinit(self.home_platform, homecoming=swapped):
+                    self.backend = self.home_platform
+                    recovery_s = self._clock() - t_lost
+                    self._last_recovery_s = recovery_s
+                    if self._m_recovery is not None:
+                        self._m_recovery.observe(recovery_s)
+                    self._event(
+                        "recovered",
+                        backend=self.home_platform,
+                        recovery_s=round(recovery_s, 3),
+                    )
+                    if self._logger is not None:
+                        self._logger.info(
+                            "device recovered; serving in device mode",
+                            backend=self.home_platform,
+                            recovery_s=round(recovery_s, 3),
+                        )
+                    return
+            elif (
+                self.allow_cpu_failover
+                and not swapped
+                and self.home_platform not in ("cpu", "unknown")
+            ):
+                # the home backend is gone for now: serve from a CPU
+                # device instead of pinning every batch on the oracle
+                if self._swap_to("cpu") and self._reinit("cpu"):
+                    swapped = True
+                    self.backend = "cpu"
+                    self._event("failover", backend="cpu")
+                    if self._logger is not None:
+                        self._logger.warn(
+                            "home backend unavailable; hot-swapped the "
+                            "engine to cpu",
+                            home=self.home_platform,
+                        )
+            if self._stop.wait(backoff):
+                return
+            backoff = min(backoff * 2, self.max_backoff_s)
+
+    def _probe_backend(self, platform: str) -> tuple[bool, str]:
+        """Is ``platform`` usable? Runs in a supervised child by default:
+        a wedged runtime hangs the CHILD, the timeout kills it, and the
+        verdict is an ordinary failure."""
+        if FAULTS.should_fire("backend.probe_hang"):
+            # stands in for the child blocking past its timeout and being
+            # killed — deterministic, no real child to wedge
+            return False, "probe hung; child killed (injected)"
+        if self.probe_mode == "inproc":
+            try:
+                import jax
+
+                n = len(jax.devices(platform))
+                return n > 0, f"{n} devices"
+            except Exception as e:
+                return False, str(e)[-200:]
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        if platform not in ("", "unknown"):
+            env["JAX_PLATFORMS"] = platform
+        try:
+            out = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; print(len(jax.devices()))",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=self.probe_timeout_s,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            return False, f"probe child killed after {self.probe_timeout_s}s"
+        except Exception as e:
+            return False, str(e)[-200:]
+        if out.returncode != 0:
+            return False, (out.stderr or "").strip()[-200:] or (
+                f"rc={out.returncode}"
+            )
+        try:
+            return int(out.stdout.strip()) > 0, out.stdout.strip() + " devices"
+        except ValueError:
+            return False, f"unparseable probe output {out.stdout!r}"
+
+    def _swap_to(self, platform: str) -> bool:
+        """Point the JAX default device at ``platform`` for every future
+        upload/dispatch in this process."""
+        try:
+            import jax
+
+            devs = jax.devices(platform)
+            if not devs:
+                return False
+            jax.config.update("jax_default_device", devs[0])
+            # the packed kernel is Mosaic/TPU; anywhere else it must run
+            # in pallas interpret mode
+            if hasattr(self.engine, "interpret"):
+                self.engine.interpret = platform not in ("tpu", "axon")
+            return True
+        except Exception as e:
+            self._event("swap_failed", backend=platform, error=str(e)[-200:])
+            return False
+
+    def _reinit(self, platform: str, homecoming: bool = False) -> bool:
+        """Teardown + re-init on ``platform``: drop device residency,
+        re-point the default device when coming home from a failover,
+        re-warm the kernels, then collapse the breaker's open window so
+        the next batch is the half-open probe."""
+        try:
+            if homecoming and not self._swap_to(platform):
+                return False
+            reset = getattr(self.engine, "reset_residency", None)
+            if reset is not None:
+                reset()
+            warmup = getattr(self.engine, "warmup", None)
+            if warmup is not None:
+                warmup(self.warm_batch)
+            breaker = self._breaker
+            if breaker is not None and hasattr(breaker, "force_probe"):
+                breaker.force_probe()
+            return True
+        except Exception as e:
+            self._event("reinit_failed", backend=platform, error=str(e)[-200:])
+            return False
+
+    # -- introspection ---------------------------------------------------------
+
+    def _event(self, event: str, **fields) -> None:
+        import time as _time
+
+        entry = {"t": _time.time(), "event": event, **fields}
+        with self._lock:
+            self._timeline.append(entry)
+            del self._timeline[: -self._TIMELINE_CAP]
+        if self._flight is not None:
+            try:
+                self._flight.record(kind="device_failover", **entry)
+            except Exception:
+                pass
+
+    def status(self) -> dict:
+        with self._lock:
+            timeline = list(self._timeline)
+            recovering = (
+                self._worker is not None and self._worker.is_alive()
+            )
+        return {
+            "enabled": self.enabled,
+            "backend": self.backend,
+            "home_platform": self.home_platform,
+            "recovering": recovering,
+            "failovers": self._failovers,
+            "last_recovery_s": self._last_recovery_s,
+            "timeline": timeline,
+        }
 
 
 class Registry:
@@ -43,6 +327,8 @@ class Registry:
         self._batcher = None
         self._checker = None
         self._engine_breaker = None
+        self._device_supervisor = None
+        self._hbm_admission = None
         self._replication_source = None
         self._replicator = None
         self._qos = None
@@ -348,8 +634,40 @@ class Registry:
                 attribution=self.attribution(),
                 profiler=self.profiler(),
                 build_phases_fn=self._build_phases,
+                device_status_fn=self._device_status,
             )
         return self._debug_context
+
+    def _device_status(self):
+        """/debug/device payload: which backend is serving, breaker and
+        quarantine state, failover timeline, HBM budget headroom. Reads
+        only already-built components — asking for status must never
+        construct an engine."""
+        out: dict = {"backend": None, "supervisor": None}
+        sup = self._device_supervisor
+        if sup is not None:
+            status = sup.status()
+            out["supervisor"] = status
+            out["backend"] = status.get("backend")
+        if out["backend"] is None:
+            try:
+                import jax
+
+                out["backend"] = jax.default_backend()
+            except Exception:
+                out["backend"] = "unknown"
+        breaker = self._engine_breaker
+        if breaker is not None:
+            snap = getattr(breaker, "breaker_snapshot", None)
+            if snap is not None:
+                out["breaker"] = snap()
+            quarantine = getattr(breaker, "quarantine_snapshot", None)
+            if quarantine is not None:
+                out["quarantine"] = quarantine()
+        hbm = self._hbm_admission
+        if hbm is not None:
+            out["hbm"] = hbm.snapshot()
+        return out
 
     def _build_phases(self):
         """Last closure-build phase timings, when the engine records them
@@ -528,6 +846,11 @@ class Registry:
                     tracer=self.tracer(),
                     metrics=self.metrics(),
                     logger=self.logger(),
+                    rebuild_gate=(
+                        hbm.wait_for_headroom
+                        if (hbm := self.hbm_admission()) is not None
+                        else None
+                    ),
                 )
             elif mode == "sharded":
                 from ..parallel import ShardedCheckEngine, make_mesh
@@ -576,6 +899,83 @@ class Registry:
             self.config.get("serve.read.max_freshness_wait_s", default=30.0)
         )
 
+    def hbm_admission(self):
+        """Device-memory budget shared by the batcher (chunk admission +
+        per-batch reserve/release) and the closure engine (rebuild gate).
+        None when engine.memory.admission is off or the engine is the host
+        oracle (no device memory to budget)."""
+        if self._hbm_admission is None:
+            if not bool(
+                self.config.get("engine.memory.admission", default=True)
+            ):
+                return None
+            if self.config.engine_mode() == "host":
+                return None
+            from ..engine.hbm import HbmAdmission
+
+            self._hbm_admission = HbmAdmission(
+                budget_frac=float(
+                    self.config.get(
+                        "engine.memory.hbm_budget_frac", default=0.8
+                    )
+                ),
+                bytes_per_row=int(
+                    self.config.get(
+                        "engine.memory.bytes_per_row", default=4096
+                    )
+                ),
+                metrics=self.metrics(),
+                logger=self.logger(),
+            )
+        return self._hbm_admission
+
+    def device_supervisor(self):
+        """Device-loss recovery loop: the breaker's on_device_lost hook
+        lands here; the supervisor re-probes the home platform in a
+        killable child, hot-swaps serving to CPU while it is gone, and
+        swaps back (re-priming buffers + half-open probe) on recovery.
+        None when engine.failover.enabled is off or the engine is the
+        host oracle (nothing to fail over)."""
+        if self._device_supervisor is None:
+            if not bool(
+                self.config.get("engine.failover.enabled", default=True)
+            ):
+                return None
+            engine = self.check_engine()
+            if isinstance(engine, CheckEngine):
+                return None
+            self._device_supervisor = DeviceSupervisor(
+                engine,
+                warm_batch=int(self.config.get("engine.max_batch")),
+                probe_mode=str(
+                    self.config.get(
+                        "engine.failover.probe_mode", default="child"
+                    )
+                ),
+                probe_timeout_s=float(
+                    self.config.get(
+                        "engine.failover.probe_timeout_s", default=10.0
+                    )
+                ),
+                probe_interval_s=float(
+                    self.config.get(
+                        "engine.failover.probe_interval_s", default=0.5
+                    )
+                ),
+                max_backoff_s=float(
+                    self.config.get(
+                        "engine.failover.max_backoff_s", default=30.0
+                    )
+                ),
+                allow_cpu_failover=bool(
+                    self.config.get("engine.failover.allow_cpu", default=True)
+                ),
+                metrics=self.metrics(),
+                logger=self.logger(),
+                flight=self.flight(),
+            )
+        return self._device_supervisor
+
     def checker(self):
         """The check entry point handlers use: batched on the device path,
         direct on the host path."""
@@ -605,6 +1005,7 @@ class Registry:
                     from ..engine.fallback import DeviceFallbackEngine
 
                     max_depth = self.config.read_api_max_depth()
+                    supervisor = self.device_supervisor()
                     engine = self._engine_breaker = DeviceFallbackEngine(
                         engine,
                         fallback_factory=lambda: CheckEngine(
@@ -620,7 +1021,16 @@ class Registry:
                         health=self.health,
                         metrics=self.metrics(),
                         logger=self.logger(),
+                        on_device_lost=(
+                            supervisor.notify_device_lost
+                            if supervisor is not None
+                            else None
+                        ),
                     )
+                    if supervisor is not None:
+                        # recovery ends with a forced half-open probe on
+                        # exactly this breaker
+                        supervisor.bind_breaker(engine)
                 self._batcher = CheckBatcher(
                     engine,
                     max_batch=int(self.config.get("engine.max_batch")),
@@ -647,6 +1057,7 @@ class Registry:
                     max_freshness_wait_s=self._freshness_cap_s,
                     tracer=self.tracer(),
                     qos=self.qos(),
+                    hbm=self.hbm_admission(),
                 )
                 self._checker = self._batcher
         return self._checker
@@ -1294,6 +1705,11 @@ class Registry:
             await self._write_plane.stop()
         if self._batcher is not None:
             self._batcher.close()
+        if self._device_supervisor is not None:
+            # after the batcher: no new launches can hit a half-recovered
+            # backend once the dispatch loops are drained
+            self._device_supervisor.stop()
+            self._device_supervisor = None
         if self._replicator is not None:
             await asyncio.get_running_loop().run_in_executor(
                 None, self._replicator.stop

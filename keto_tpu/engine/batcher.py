@@ -102,7 +102,7 @@ class _PBatch:
     """One batch moving through the pipeline: queue items plus per-stage
     artifacts and timestamps."""
 
-    __slots__ = ("items", "enc", "launched", "keys", "t_encoded")
+    __slots__ = ("items", "enc", "launched", "keys", "t_encoded", "hbm_token")
 
     def __init__(self, items):
         # [(request, depth, Future, t_enqueued, deadline, ledger,
@@ -112,6 +112,7 @@ class _PBatch:
         self.launched = None  # LaunchedBatch after the launch stage
         self.keys = None  # encoded-cache keys (when the cache is on)
         self.t_encoded = 0.0
+        self.hbm_token = 0  # HBM admission reservation; 0 = none held
 
 
 class _Holder:
@@ -145,10 +146,12 @@ class CheckBatcher:
         max_freshness_wait_s=30.0,
         tracer=None,  # stage spans join the caller's trace when set
         qos=None,  # NamespaceQos: per-tenant token-bucket admission
+        hbm=None,  # HbmAdmission: device-memory budget; None disables
     ):
         self.engine = engine
         self.tracer = tracer
         self.qos = qos
+        self.hbm = hbm
         self.max_batch = max_batch
         self.window_s = window_s
         self.cache = cache
@@ -468,6 +471,14 @@ class CheckBatcher:
         ledger_mark("decode")
         return out
 
+    def _admit_rows(self) -> int:
+        """Chunk size the HBM admission controller will currently accept:
+        ``max_batch`` clamped to the budget headroom left by in-flight
+        batches. Re-asked per chunk — headroom moves as batches decode."""
+        if self.hbm is None:
+            return self.max_batch
+        return max(1, self.hbm.clamp_rows(self.max_batch))
+
     def _dispatch_direct(self, requests, max_depth: int) -> list[bool]:
         """Monolithic engine dispatch for a caller-assembled batch, under
         a stage span that joins the caller's trace via the ambient
@@ -477,10 +488,10 @@ class CheckBatcher:
                 "batcher.dispatch", batch_size=len(requests)
             ):
                 return dispatch_batched(
-                    self.engine, requests, max_depth, self.max_batch
+                    self.engine, requests, max_depth, self._admit_rows()
                 )
         return dispatch_batched(
-            self.engine, requests, max_depth, self.max_batch
+            self.engine, requests, max_depth, self._admit_rows()
         )
 
     def check_batch_columnar(
@@ -526,13 +537,16 @@ class CheckBatcher:
         if getattr(self.engine, "encode_columns", None) is None:
             return self._columns_via_engine(cols, max_depth)
         out: list[bool] = []
-        for i in range(0, n, self.max_batch):
+        i = 0
+        while i < n:
+            step = self._admit_rows()
             chunk = (
                 cols
-                if n <= self.max_batch
-                else cols.select(range(i, min(i + self.max_batch, n)))
+                if i == 0 and n <= step
+                else cols.select(range(i, min(i + step, n)))
             )
             out.extend(self._dispatch_columns(chunk, max_depth))
+            i += step
         return out
 
     def _dispatch_columns(self, cols, max_depth: int) -> list[bool]:
@@ -611,11 +625,13 @@ class CheckBatcher:
         run = getattr(self.engine, "batch_check_columns", None)
         out: list[bool] = []
         n = len(cols)
-        for i in range(0, n, self.max_batch):
+        i = 0
+        while i < n:
+            step = self._admit_rows()
             chunk = (
                 cols
-                if n <= self.max_batch
-                else cols.select(range(i, min(i + self.max_batch, n)))
+                if i == 0 and n <= step
+                else cols.select(range(i, min(i + step, n)))
             )
             if run is not None:
                 out.extend(bool(v) for v in run(chunk, max_depth))
@@ -626,6 +642,7 @@ class CheckBatcher:
                         chunk.materialize(), max_depth
                     )
                 )
+            i += step
         return out
 
     def check_batch_encoded(
@@ -671,14 +688,17 @@ class CheckBatcher:
             d = want
         ledger_mark("admission")
         out: list[bool] = []
-        for i in range(0, n, self.max_batch):
+        i = 0
+        while i < n:
+            step = self._admit_rows()
             out.extend(
                 self._dispatch_encoded(
-                    s[i : i + self.max_batch],
-                    t[i : i + self.max_batch],
-                    d[i : i + self.max_batch],
+                    s[i : i + step],
+                    t[i : i + step],
+                    d[i : i + step],
                 )
             )
+            i += step
         return out
 
     def _dispatch_encoded(self, s, t, d) -> list[bool]:
@@ -800,7 +820,7 @@ class CheckBatcher:
     # -- shared plumbing -----------------------------------------------------
 
     def _drain(self) -> list[tuple]:
-        batch = self._queue[: self.max_batch]
+        batch = self._queue[: self._admit_rows()]
         del self._queue[: len(batch)]
         return batch
 
@@ -988,6 +1008,9 @@ class CheckBatcher:
     def _complete(self, batch: _PBatch) -> None:
         with self._lock:
             self._pipe_batches.pop(id(batch), None)
+        if self.hbm is not None and batch.hbm_token:
+            self.hbm.release(batch.hbm_token)
+            batch.hbm_token = 0
 
     def _fail_batch(self, batch: _PBatch, exc: BaseException) -> None:
         self._complete(batch)
@@ -1156,6 +1179,13 @@ class CheckBatcher:
             if batch.keys is not None:
                 batch.keys = [batch.keys[i] for i in keep_idx]
             self._set_deadlines(batch.enc, batch.items)
+        if self.hbm is not None:
+            # charge the batch's modeled HBM footprint before dispatch;
+            # released in _complete/_fail_batch once it leaves the device
+            batch.hbm_token = self.hbm.reserve(
+                getattr(batch.enc, "b", 0) or 0,
+                getattr(batch.enc, "version", 0) or 0,
+            )
         try:
             batch.launched = self.engine.launch_encoded(batch.enc)
         except Exception as e:

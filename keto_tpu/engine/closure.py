@@ -210,6 +210,8 @@ class ClosureCheckEngine:
         tracer=None,
         metrics=None,
         logger=None,
+        rebuild_gate=None,  # zero-arg callable; blocks until the device
+        # has memory headroom for a rebuild (HbmAdmission.wait_for_headroom)
     ):
         self.snapshots = snapshots
         self.global_max_depth = max_depth
@@ -231,6 +233,7 @@ class ClosureCheckEngine:
         self._host_queries: Optional[bool] = (
             None if query_mode == "auto" else query_mode == "host"
         )
+        self._rebuild_gate = rebuild_gate
         self._lock = threading.Lock()  # guards _rebuilding
         self._build_lock = threading.Lock()  # serializes state builds
         self._state_cv = threading.Condition()  # notified on state swap
@@ -478,6 +481,14 @@ class ClosureCheckEngine:
             while True:
                 if self.rebuild_debounce_s > 0:
                     time.sleep(self.rebuild_debounce_s)  # coalesce bursts
+                if self._rebuild_gate is not None:
+                    # serialize the rebuild's device peak against in-flight
+                    # batch memory; the gate times out rather than starving
+                    # the rebuild, so staleness stays bounded either way
+                    try:
+                        self._rebuild_gate()
+                    except Exception:
+                        pass
                 state = self._build_sync()
                 # exit check and flag clear are atomic wrt _kick_rebuild:
                 # otherwise a write landing between them would see

@@ -313,7 +313,16 @@ class DeviceCheckEngine:
             self._cached = dg
             return dg
 
-    # -- public API ----------------------------------------------------------
+    def reset_residency(self) -> None:
+        """Drop every device-resident artifact: the uploaded edge arrays /
+        dense adjacency, the staging free-lists hanging off them, and the
+        packed mode's scatter companion. The next dispatch re-uploads from
+        the live snapshot. This is the device-lost recovery seam — after a
+        backend teardown/re-init the old buffers belong to a dead client
+        and must never be touched again."""
+        with self._lock:
+            self._cached = None
+            self._scatter_companion = None
 
     def warmup(self, batch: int = 1) -> None:
         """Compile the kernel for the current snapshot shape at production
@@ -474,7 +483,23 @@ class DeviceCheckEngine:
     ) -> EncodedBatch:
         """Stage 1 for pre-encoded id batches (check_batch_encoded): the
         ids go straight into staging — no vocab probe at all."""
-        snap = self.snapshots.snapshot()
+        return self.encode_ids_at(
+            self.snapshots.snapshot(), start, target, depths
+        )
+
+    def encode_ids_at(
+        self,
+        snap: GraphSnapshot,
+        start,
+        target,
+        depths: Optional[Sequence[int]] = None,
+    ) -> EncodedBatch:
+        """encode_ids pinned to an explicit snapshot. Node ids are only
+        meaningful against the vocab that produced them (the dummy id in
+        particular is ``padded_nodes - 1``, which moves as the graph
+        grows), so the OOM-bisection retry in engine/fallback.py re-encodes
+        its halves against the *parent batch's* snapshot — never a fresh
+        one."""
         dg = self._device_graph(snap)
         n = len(start)
         b = (
@@ -508,11 +533,16 @@ class DeviceCheckEngine:
     def launch_encoded(self, enc: EncodedBatch) -> LaunchedBatch:
         """Stage 2 (the device stage): enqueue the kernel. Returns as soon
         as dispatch is accepted — the result array is still on device."""
-        # fault sites: stand-ins for an XLA compile failure, a numerically
-        # sick chip returning garbage, and a slow/wedged dispatch — the
-        # circuit breaker in engine/fallback.py and the deadline culls in
-        # engine/batcher.py are tested against exactly these
+        # fault sites: stand-ins for an XLA compile failure, an HBM
+        # out-of-memory, a lost device, a shape-specific compile failure,
+        # a numerically sick chip returning garbage, and a slow/wedged
+        # dispatch — the typed recovery policies in engine/fallback.py,
+        # the device supervisor in driver/registry.py, and the deadline
+        # culls in engine/batcher.py are tested against exactly these
         FAULTS.fire("device.compile_error")
+        FAULTS.fire("device.oom")
+        FAULTS.fire("device.compile_fail")
+        FAULTS.fire("device.lost")
         FAULTS.maybe_sleep("device.slow")
         if FAULTS.should_fire("device.batch_nan"):
             return LaunchedBatch(enc, garbage=True)

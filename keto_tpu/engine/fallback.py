@@ -16,7 +16,28 @@ mode instead of an implicit crash mode:
   counter records every fallback-served batch;
 - after the cooldown one probe batch rides the primary (half-open); success
   closes the breaker and restores SERVING, failure re-opens it with
-  doubled cooldown (capped).
+  doubled cooldown (capped) plus jitter — a fixed re-probe interval can
+  resonate with a flapping device, so every open window is stretched by a
+  random fraction of itself.
+
+Not every device error deserves the breaker. :func:`classify_device_error`
+types each failure and routes it to a recovery policy:
+
+- **oom** (RESOURCE_EXHAUSTED / allocation failure): the batch was too big
+  for current HBM headroom, not a sick device — bisect the encoded batch,
+  re-dispatch the halves against the *same* snapshot, merge in order
+  (parity-exact: the kernels answer rows independently). Bounded recursion
+  depth; a single-row OOM degrades to the host oracle.
+- **compile_fail** (shape-specific XLA compilation failure): quarantine
+  that (bucket, snapshot-version) shape — route it to the host oracle
+  without tripping the global breaker, because every *other* shape still
+  compiles and serves fine.
+- **device_lost** (DEVICE_LOST / dead driver): force the breaker open
+  immediately (no threshold — the device is gone for every future batch)
+  and notify the device supervisor (``on_device_lost``), which tears the
+  engine down and re-probes the backend while the oracle covers the gap.
+- **transient** (everything else): the original consecutive-failure
+  threshold semantics.
 
 The wrapper is transparent: everything the batcher/registry reach through
 (``wait_for_version``, ``answering_version``, ``warmup``, ...) delegates to
@@ -25,13 +46,61 @@ the primary engine.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Optional, Sequence
 
+from ..faults import FaultInjected
 from ..relationtuple.definitions import RelationTuple
 
 _COOLDOWN_CAP_S = 60.0
+#: every open window is stretched by up to this fraction of itself
+_JITTER_FRAC = 0.25
+#: bisection recursion bound: 2^6 = 64 sub-batches from one OOM at worst
+_MAX_BISECT_DEPTH = 6
+#: quarantined (bucket, snapshot-version) shapes kept; oldest pruned first
+_QUARANTINE_CAP = 64
+
+#: injected-fault sites mapped straight to their error class — the drills
+#: arm these instead of fabricating XLA status strings
+_FAULT_SITE_KINDS = {
+    "device.oom": "oom",
+    "device.lost": "device_lost",
+    "device.compile_fail": "compile_fail",
+}
+
+
+def classify_device_error(err: BaseException) -> str:
+    """Type a raised device/XLA error: ``oom`` | ``device_lost`` |
+    ``compile_fail`` | ``transient``. Matching is on exception type name
+    plus XLA status-message substrings — no hard jaxlib import, because
+    the host-only test mesh must classify the same way the TPU does."""
+    if isinstance(err, FaultInjected):
+        return _FAULT_SITE_KINDS.get(err.site, "transient")
+    msg = str(err).lower()
+    if (
+        "resource_exhausted" in msg
+        or "out of memory" in msg
+        or "failed to allocate" in msg
+        or "allocation failure" in msg
+    ):
+        return "oom"
+    if (
+        "device_lost" in msg
+        or "device lost" in msg
+        or "device or resource busy" in msg
+        or "failed_precondition: device" in msg
+    ):
+        return "device_lost"
+    name = type(err).__name__
+    if "compilation failure" in msg or "xla compilation" in msg:
+        return "compile_fail"
+    if name in ("XlaRuntimeError", "JaxRuntimeError", "JaxStackTraceBeforeTransformation") and (
+        "compil" in msg or "mosaic" in msg or "unsupported" in msg
+    ):
+        return "compile_fail"
+    return "transient"
 
 
 class _FallbackAnswered:
@@ -86,6 +155,10 @@ class DeviceFallbackEngine:
         metrics=None,
         logger=None,
         clock=time.monotonic,
+        on_device_lost=None,  # DeviceSupervisor.notify_device_lost
+        max_bisect_depth: int = _MAX_BISECT_DEPTH,
+        jitter_frac: float = _JITTER_FRAC,
+        rng=None,  # injectable random.Random for deterministic jitter tests
     ):
         self.primary = primary
         self._fallback_factory = fallback_factory
@@ -95,16 +168,26 @@ class DeviceFallbackEngine:
         self.health = health
         self._logger = logger
         self._clock = clock
+        self._on_device_lost = on_device_lost
+        self.max_bisect_depth = max(0, int(max_bisect_depth))
+        self.jitter_frac = max(0.0, float(jitter_frac))
+        self._rng = rng if rng is not None else random.Random()
         self._lock = threading.Lock()
         self._consecutive_failures = 0
         self._open_until: Optional[float] = None  # None = closed
         self._cooldown_s = cooldown_s
         self._probing = False  # half-open: one probe at a time
         self._degraded_health = False  # only restore what WE took down
+        # (bucket, snapshot-version) -> quarantined-at (breaker clock):
+        # shapes whose compile failed, served by the oracle without opening
+        # the circuit; insertion-ordered so the cap prunes oldest first
+        self._quarantine: dict[tuple[int, int], float] = {}
         self._m_failures = None
         self._m_fallback_batches = None
         self._m_open = None
         self._m_deadline_skips = None
+        self._m_bisections = None
+        self._m_quarantine = None
         if metrics is not None:
             self._m_failures = metrics.counter(
                 "keto_device_engine_failures_total",
@@ -124,12 +207,31 @@ class DeviceFallbackEngine:
                 "rows the host-oracle fallback did not re-answer because "
                 "their caller deadline had already passed",
             )
+            self._m_bisections = metrics.counter(
+                "keto_device_oom_bisections_total",
+                "encoded batches split in half and re-dispatched after a "
+                "device out-of-memory",
+            )
+            self._m_quarantine = metrics.gauge(
+                "keto_compile_quarantine_size",
+                "(bucket, snapshot-version) shapes quarantined to the host "
+                "oracle after a shape-specific compile failure",
+            )
 
     # -- breaker bookkeeping ---------------------------------------------------
 
     def circuit_open(self) -> bool:
         with self._lock:
             return self._open_until is not None
+
+    def force_probe(self) -> None:
+        """Collapse the open window: the next batch becomes the half-open
+        probe NOW. The device supervisor calls this after a successful
+        teardown/re-init — waiting out a (possibly doubled) cooldown after
+        the device is already back just burns oracle latency."""
+        with self._lock:
+            if self._open_until is not None:
+                self._open_until = self._clock()
 
     def _fallback_engine(self):
         if self._fallback is None:
@@ -148,7 +250,12 @@ class DeviceFallbackEngine:
             self._probing = True
             return True
 
-    def _record_failure(self, err: Optional[BaseException]) -> None:
+    def _record_failure(
+        self, err: Optional[BaseException], force_open: bool = False
+    ) -> None:
+        """``force_open`` opens the circuit regardless of the consecutive
+        threshold — a lost device fails every future batch, so waiting out
+        the threshold just burns caller latency."""
         if self._m_failures is not None:
             self._m_failures.inc()
         with self._lock:
@@ -158,12 +265,19 @@ class DeviceFallbackEngine:
             if was_open:
                 # failed probe: re-open, back off harder
                 self._cooldown_s = min(self._cooldown_s * 2, _COOLDOWN_CAP_S)
-                self._open_until = self._clock() + self._cooldown_s
                 tripped = False
             else:
-                tripped = self._consecutive_failures >= self.failure_threshold
-                if tripped:
-                    self._open_until = self._clock() + self._cooldown_s
+                tripped = (
+                    force_open
+                    or self._consecutive_failures >= self.failure_threshold
+                )
+            if tripped or was_open:
+                # jittered open window: a flapping device must not phase-
+                # lock with the half-open probe cadence
+                jitter = (
+                    self._cooldown_s * self.jitter_frac * self._rng.random()
+                )
+                self._open_until = self._clock() + self._cooldown_s + jitter
             take_health_down = (tripped or was_open) and not self._degraded_health
             if take_health_down:
                 self._degraded_health = True
@@ -200,6 +314,59 @@ class DeviceFallbackEngine:
         if restore and self.health is not None:
             self.health.set_serving(True)
 
+    def _note_failure(self, err: Optional[BaseException]) -> None:
+        """Typed failure bookkeeping for the non-launch seams: device-lost
+        forces the circuit open and wakes the supervisor; everything else
+        keeps the consecutive-threshold semantics."""
+        if err is not None and classify_device_error(err) == "device_lost":
+            self._record_failure(err, force_open=True)
+            self._notify_device_lost(err)
+        else:
+            self._record_failure(err)
+
+    def _notify_device_lost(self, err: BaseException) -> None:
+        cb = self._on_device_lost
+        if cb is None:
+            return
+        try:
+            cb(err)
+        except Exception:
+            pass  # the supervisor is best-effort; serving must not care
+
+    # -- compile quarantine ----------------------------------------------------
+
+    def _quarantined(self, key: tuple[int, int]) -> bool:
+        with self._lock:
+            return key in self._quarantine
+
+    def _add_quarantine(self, key: tuple[int, int]) -> None:
+        with self._lock:
+            self._quarantine[key] = self._clock()
+            while len(self._quarantine) > _QUARANTINE_CAP:
+                self._quarantine.pop(next(iter(self._quarantine)))
+            size = len(self._quarantine)
+        if self._m_quarantine is not None:
+            self._m_quarantine.set(size)
+
+    def quarantine_snapshot(self) -> list[dict]:
+        """The quarantined shapes, for /debug/device."""
+        with self._lock:
+            return [
+                {"bucket": b, "snapshot_version": v, "since": t}
+                for (b, v), t in self._quarantine.items()
+            ]
+
+    def breaker_snapshot(self) -> dict:
+        """Breaker internals, for /debug/device."""
+        with self._lock:
+            return {
+                "open": self._open_until is not None,
+                "consecutive_failures": self._consecutive_failures,
+                "cooldown_s": self._cooldown_s,
+                "probing": self._probing,
+                "quarantine_size": len(self._quarantine),
+            }
+
     # -- check surface ---------------------------------------------------------
 
     def batch_check(
@@ -216,7 +383,7 @@ class DeviceFallbackEngine:
                     requests, max_depth, depths=depths
                 )
             except Exception as e:
-                self._record_failure(e)
+                self._note_failure(e)
                 return self._fallback_check(requests, max_depth, depths)
             if not _valid_batch(results, len(requests)):
                 self._record_failure(None)
@@ -245,21 +412,137 @@ class DeviceFallbackEngine:
     def encode_batch(self, requests, max_depth=0, depths=None):
         return self.primary.encode_batch(requests, max_depth, depths=depths)
 
+    @staticmethod
+    def _shape_key(enc) -> tuple:
+        # tolerant of minimal engine stand-ins in tests: an unknown shape
+        # (None, None) can be quarantined like any other
+        return (getattr(enc, "b", None), getattr(enc, "version", None))
+
     def launch_encoded(self, enc):
+        if self._quarantined(self._shape_key(enc)):
+            # this (bucket, snapshot) shape failed to compile: route it to
+            # the oracle without consulting (or charging) the breaker
+            return self._answer_from_oracle(enc)
         if self._use_primary():
             try:
                 return self.primary.launch_encoded(enc)
             except Exception as e:
-                self._record_failure(e)
+                handled = self._handle_launch_error(enc, e)
+                if handled is not None:
+                    return handled
         # circuit open (or the launch itself died): answer this batch from
         # the host oracle NOW — its staging buffers go back to the pool and
         # decode becomes a no-op unwrap
+        return self._answer_from_oracle(enc)
+
+    def _answer_from_oracle(self, enc) -> "_FallbackAnswered":
         requests, depths = enc.requests, enc.depths
         deadlines = getattr(enc, "deadlines", None)
         enc.release()
         return _FallbackAnswered(
             self._fallback_check(requests, 0, depths, deadlines)
         )
+
+    def _handle_launch_error(self, enc, err):
+        """Typed recovery for a failed launch. Returns a ``_FallbackAnswered``
+        when a policy absorbed the error (bisection answered exactly, or the
+        shape went to quarantine); ``None`` sends the caller down the
+        breaker's host-oracle path."""
+        kind = classify_device_error(err)
+        if kind == "oom":
+            results = self._bisect_oom(enc)
+            if results is not None:
+                # the batch was too big for current HBM headroom, not a
+                # sick device: the halves answered, the breaker stays closed
+                self._record_success()
+                return _FallbackAnswered(results)
+            self._record_failure(err)
+            return None
+        if kind == "compile_fail":
+            key = self._shape_key(enc)
+            self._add_quarantine(key)
+            if self._logger is not None:
+                self._logger.warn(
+                    "compile failure: quarantining batch shape to the "
+                    "host oracle",
+                    bucket=key[0],
+                    snapshot_version=key[1],
+                    error=str(err),
+                )
+            return self._answer_from_oracle(enc)
+        if kind == "device_lost":
+            self._record_failure(err, force_open=True)
+            self._notify_device_lost(err)
+            return None
+        self._record_failure(err)
+        return None
+
+    # -- OOM bisection ---------------------------------------------------------
+
+    def _bisect_oom(self, enc) -> Optional[list[bool]]:
+        """Split-and-retry for an OOM'd launch: snapshot the encoded ids,
+        re-encode the halves against the parent batch's snapshot, dispatch
+        each, merge in order. Returns the merged bool list (parity-exact
+        with the unsplit answer — the kernels answer rows independently),
+        or None when bisection can't help (single row, unsupported engine,
+        a half failed for a non-OOM reason, depth exhausted)."""
+        n = getattr(enc, "n", 0)
+        if self.max_bisect_depth <= 0 or n <= 1:
+            return None
+        encode_at = getattr(self.primary, "encode_ids_at", None)
+        if encode_at is None:
+            return None
+        try:
+            start = enc.start[: n].copy()
+            target = enc.target[: n].copy()
+            depths = list(enc.depths) if enc.depths is not None else [0] * n
+            results = self._bisect_ids(enc.snap, start, target, depths, 1)
+        except Exception:
+            return None
+        if results is None or not _valid_batch(results, n):
+            return None
+        enc.release()
+        if self._logger is not None:
+            self._logger.info(
+                "device OOM absorbed by batch bisection", rows=n
+            )
+        return [bool(v) for v in results]
+
+    def _bisect_ids(self, snap, start, target, depths, depth):
+        if self._m_bisections is not None:
+            self._m_bisections.inc()
+        mid = len(start) // 2
+        merged: list = []
+        for lo, hi in ((0, mid), (mid, len(start))):
+            sub = self._dispatch_ids(
+                snap, start[lo:hi], target[lo:hi], depths[lo:hi], depth
+            )
+            if sub is None:
+                return None
+            merged.extend(sub)
+        return merged
+
+    def _dispatch_ids(self, snap, start, target, depths, depth):
+        enc = self.primary.encode_ids_at(snap, start, target, depths)
+        try:
+            launched = self.primary.launch_encoded(enc)
+        except Exception as e:
+            # a raised launch leaves the half's staging buffers checked out
+            enc.release()
+            if (
+                classify_device_error(e) == "oom"
+                and len(start) > 1
+                and depth < self.max_bisect_depth
+            ):
+                return self._bisect_ids(snap, start, target, depths, depth + 1)
+            return None
+        try:
+            results = self.primary.decode_launched(launched)
+        except Exception:
+            return None  # primary's decode releases in its finally
+        if not _valid_batch(results, len(start)):
+            return None
+        return list(results)
 
     def decode_launched(self, launched) -> list[bool]:
         if isinstance(launched, _FallbackAnswered):
@@ -285,7 +568,7 @@ class DeviceFallbackEngine:
         try:
             results = self.primary.decode_launched(launched)
         except Exception as e:
-            self._record_failure(e)
+            self._note_failure(e)
             return self._fallback_check(
                 requests if requests is not None else enc.requests,
                 0,
@@ -320,7 +603,7 @@ class DeviceFallbackEngine:
             try:
                 results = run(cols, max_depth, depths)
             except Exception as e:
-                self._record_failure(e)
+                self._note_failure(e)
                 return self._fallback_check(
                     cols.materialize(), max_depth, depths
                 )

@@ -1,0 +1,272 @@
+"""HBM admission control: a memory model between the batcher and the device.
+
+BENCH_r05 ran rbac100m at 28.8 GB RSS with no memory budget enforced
+anywhere: nothing stopped the batcher from launching a batch whose staging
++ frontier working set landed exactly on top of a closure rebuild's peak,
+and the first OOM the process saw was the XLA allocator's. This module puts
+the budget *before* the allocator:
+
+- the budget is ``hbm_budget_frac`` of the smallest accelerator's
+  ``bytes_limit``, calibrated from ``devstats`` ``memory_stats()`` samples
+  (re-sampled periodically — other processes share the chip);
+- every launched batch reserves its modeled bytes for the (bucket,
+  snapshot-version) shape it dispatches; the model starts from a
+  conservative per-row constant and learns from observed
+  ``peak_bytes_in_use`` deltas (EMA) as real batches fly;
+- admission clamps the batcher's chunk size so an oversized caller batch is
+  pre-split *before* encode instead of OOMing in launch, and
+  :meth:`wait_for_headroom` lets the closure engine serialize its rebuild
+  against in-flight batch memory so rebuild + serving can't co-OOM.
+
+On hosts without device memory stats (CPU test meshes return ``None``)
+every admission question degrades to "yes, unlimited" at the cost of one
+``None`` check — tier-1 behavior is unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..telemetry.devstats import DEVSTATS
+
+#: seconds between budget re-calibrations (bytes_limit moves when other
+#: processes grab chip memory; bytes_in_use moves constantly)
+_CALIBRATE_EVERY_S = 30.0
+#: starting guess for modeled bytes per batch row before any observation:
+#: 3 int32 staging columns + frontier working set, deliberately generous
+_DEFAULT_BYTES_PER_ROW = 4096
+#: learned-model EMA weight for a fresh peak observation
+_EMA_ALPHA = 0.3
+#: never clamp a batch below this many rows — the kernels' minimum bucket
+_MIN_ROWS = 8
+
+
+class HbmAdmission:
+    """Shared by the batcher (admission/pre-split + per-batch reserve/
+    release) and the closure engine (rebuild gate). Thread-safe; every
+    hot-path call is O(1) under one lock."""
+
+    def __init__(
+        self,
+        budget_frac: float = 0.8,
+        bytes_per_row: int = _DEFAULT_BYTES_PER_ROW,
+        metrics=None,
+        logger=None,
+        devstats=DEVSTATS,
+        clock=None,
+    ):
+        import time as _time
+
+        self.budget_frac = min(1.0, max(0.05, float(budget_frac)))
+        self._bytes_per_row = float(bytes_per_row or _DEFAULT_BYTES_PER_ROW)
+        self._devstats = devstats
+        self._logger = logger
+        self._clock = clock or _time.monotonic
+        self._lock = threading.Lock()
+        self._headroom_wake = threading.Condition(self._lock)
+        # None until a device reports memory stats; None = admission off
+        self._budget_bytes: Optional[float] = None
+        self._calibrated_at: float = float("-inf")
+        # (bucket, snapshot-version) -> modeled bytes for one such batch
+        self._model: dict[tuple[int, int], float] = {}
+        # token -> (modeled cost, shape key, peak sample at reserve time)
+        self._inflight: dict[
+            int, tuple[float, tuple[int, int], Optional[float]]
+        ] = {}
+        self._inflight_bytes = 0.0
+        self._next_token = 0
+        self._m_splits = None
+        if metrics is not None:
+            metrics.gauge(
+                "keto_hbm_budget_bytes",
+                "HBM bytes the admission controller budgets for check "
+                "batches (hbm_budget_frac of the smallest device limit; "
+                "0 = no device memory stats, admission disabled)",
+                fn=lambda: float(self.budget_bytes() or 0.0),
+            )
+            metrics.gauge(
+                "keto_hbm_inflight_bytes",
+                "modeled HBM bytes of currently in-flight check batches",
+                fn=lambda: self._inflight_bytes,
+            )
+            self._m_splits = metrics.counter(
+                "keto_hbm_admission_splits_total",
+                "caller batches pre-split at admission because their "
+                "modeled HBM footprint exceeded the budget headroom",
+            )
+
+    # -- calibration -----------------------------------------------------------
+
+    def _calibrate_locked(self) -> None:
+        now = self._clock()
+        if now - self._calibrated_at < _CALIBRATE_EVERY_S:
+            return
+        self._calibrated_at = now
+        limit = None
+        try:
+            for dev in self._devstats.sample_devices():
+                stats = dev.get("memory_stats")
+                if not stats:
+                    continue
+                dev_limit = float(stats.get("bytes_limit") or 0)
+                if dev_limit > 0 and (limit is None or dev_limit < limit):
+                    limit = dev_limit
+        except Exception:
+            limit = None
+        self._budget_bytes = (
+            limit * self.budget_frac if limit is not None else None
+        )
+
+    def budget_bytes(self) -> Optional[float]:
+        """The current batch-memory budget; None = no accelerator memory
+        stats, admission disabled."""
+        with self._lock:
+            self._calibrate_locked()
+            return self._budget_bytes
+
+    # -- the memory model ------------------------------------------------------
+
+    def _modeled_bytes_locked(self, bucket: int, version: int) -> float:
+        known = self._model.get((bucket, version))
+        if known is not None:
+            return known
+        return bucket * self._bytes_per_row
+
+    def modeled_bytes(self, bucket: int, version: int) -> float:
+        with self._lock:
+            return self._modeled_bytes_locked(bucket, version)
+
+    def _observe_peak_delta(
+        self, key: tuple[int, int], delta_bytes: float
+    ) -> None:
+        """Fold an observed peak_bytes_in_use delta for one batch into the
+        per-shape model and the per-row EMA. Zero deltas (the batch fit
+        under the existing high-water mark) carry no information."""
+        if delta_bytes <= 0:
+            return
+        with self._lock:
+            old = self._model.get(key)
+            self._model[key] = (
+                delta_bytes
+                if old is None
+                else (1 - _EMA_ALPHA) * old + _EMA_ALPHA * delta_bytes
+            )
+            if len(self._model) > 256:
+                self._model.pop(next(iter(self._model)))
+            per_row = delta_bytes / max(1, key[0])
+            self._bytes_per_row = (
+                (1 - _EMA_ALPHA) * self._bytes_per_row + _EMA_ALPHA * per_row
+            )
+
+    def _peak_bytes(self) -> Optional[float]:
+        """Current peak_bytes_in_use, or None when no device reports
+        memory stats (a peak of 0 on a fresh process is a real sample)."""
+        try:
+            for dev in self._devstats.sample_devices():
+                stats = dev.get("memory_stats")
+                if stats:
+                    return float(stats.get("peak_bytes_in_use") or 0)
+        except Exception:
+            pass
+        return None
+
+    # -- admission -------------------------------------------------------------
+
+    def clamp_rows(self, rows: int) -> int:
+        """Largest batch (<= ``rows``) whose modeled footprint fits the
+        budget headroom left by in-flight batches — the batcher's chunk
+        loops call this per chunk, so an oversized caller batch is
+        pre-split at admission instead of OOMing in launch."""
+        with self._lock:
+            self._calibrate_locked()
+            budget = self._budget_bytes
+            if budget is None or rows <= _MIN_ROWS:
+                return rows
+            headroom = max(0.0, budget - self._inflight_bytes)
+            per_row = max(1.0, self._bytes_per_row)
+            fit = int(headroom / per_row)
+            if fit >= rows:
+                return rows
+        if self._m_splits is not None:
+            self._m_splits.inc()
+        if self._logger is not None:
+            self._logger.info(
+                "HBM admission pre-split", requested=rows,
+                admitted=max(_MIN_ROWS, fit),
+            )
+        return max(_MIN_ROWS, fit)
+
+    def reserve(self, bucket: int, version: int) -> int:
+        """Charge one (bucket, version) batch against the budget; returns
+        a token for :meth:`release`. Token 0 = admission disabled, free."""
+        with self._lock:
+            self._calibrate_locked()
+            if self._budget_bytes is None:
+                return 0
+            cost = self._modeled_bytes_locked(bucket, version)
+            self._next_token += 1
+            token = self._next_token
+            self._inflight[token] = (cost, (bucket, version), None)
+        peak = self._peak_bytes()
+        with self._lock:
+            if token in self._inflight:
+                self._inflight[token] = (cost, (bucket, version), peak)
+                self._inflight_bytes += cost
+        return token
+
+    def release(self, token: int) -> None:
+        if token == 0:
+            return
+        with self._lock:
+            entry = self._inflight.pop(token, None)
+            if entry is None:
+                return
+            cost, key, peak_before = entry
+            self._inflight_bytes = max(0.0, self._inflight_bytes - cost)
+            self._headroom_wake.notify_all()
+        peak_after = self._peak_bytes()
+        if peak_before is not None and peak_after is not None:
+            self._observe_peak_delta(key, peak_after - peak_before)
+
+    # -- rebuild gating --------------------------------------------------------
+
+    def wait_for_headroom(
+        self, frac: float = 0.5, timeout_s: float = 30.0
+    ) -> bool:
+        """Block until in-flight batch memory drops under ``frac`` of the
+        budget (the closure engine calls this before a rebuild so rebuild
+        peak + serving peak never stack). Returns False on timeout — the
+        rebuild proceeds anyway, because a starved rebuild is unbounded
+        staleness, which is worse than a risked OOM the breaker can
+        absorb."""
+        deadline = self._clock() + max(0.0, timeout_s)
+        with self._lock:
+            self._calibrate_locked()
+            while True:
+                budget = self._budget_bytes
+                if budget is None or self._inflight_bytes <= budget * frac:
+                    return True
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    return False
+                self._headroom_wake.wait(min(remaining, 0.25))
+
+    # -- introspection ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            budget = self._budget_bytes
+            return {
+                "budget_bytes": budget,
+                "budget_frac": self.budget_frac,
+                "inflight_bytes": self._inflight_bytes,
+                "inflight_batches": len(self._inflight),
+                "headroom_bytes": (
+                    None
+                    if budget is None
+                    else max(0.0, budget - self._inflight_bytes)
+                ),
+                "bytes_per_row": round(self._bytes_per_row, 1),
+                "modeled_shapes": len(self._model),
+            }

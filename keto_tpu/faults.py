@@ -44,6 +44,22 @@ site                      effect when armed
 ``device.batch_nan``      the device engine returns non-boolean garbage for
                           the batch, as a numerically sick chip would
                           (engine/device.py)
+``device.oom``            a launch raises as an XLA RESOURCE_EXHAUSTED (HBM
+                          out-of-memory) would; the breaker's OOM policy
+                          must bisect and re-dispatch the batch halves
+                          (engine/device.py + engine/fallback.py)
+``device.compile_fail``   a launch raises as a *shape-specific* XLA
+                          compilation failure would; the (bucket, snapshot)
+                          quarantine must absorb it without tripping the
+                          global breaker (engine/device.py)
+``device.lost``           a launch raises as a DEVICE_LOST / wedged-driver
+                          error would; the device supervisor must tear the
+                          engine down and re-init through a backend probe
+                          (engine/device.py + driver/registry.py)
+``backend.probe_hang``    the supervisor's backend re-probe "hangs" the way
+                          ``jax.devices()`` did in BENCH_r05; the supervised
+                          probe must count it as a failed attempt instead of
+                          wedging the loop (driver/registry.py)
 ``client.unavailable``    test-only site for client retry paths
 ``wal.torn_write``        a WAL append writes only half its frame to disk
                           before "the process dies" — replay must truncate

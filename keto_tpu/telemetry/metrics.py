@@ -366,6 +366,36 @@ def recovery_metrics(
     )
 
 
+# -- device fault / failover telemetry ----------------------------------------
+
+# a recovery is probe + residency rebuild + re-warmup: sub-second on a warm
+# CPU mesh, tens of seconds when the re-init pays an XLA compile
+RECOVERY_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+def device_failover_metrics(
+    registry: MetricsRegistry,
+) -> tuple[Counter, Histogram]:
+    """(failovers, recovery_seconds) for the device supervisor
+    (driver/registry.py): failovers counts every device-lost/backend-swap
+    event the supervisor handled; recovery_seconds measures device-lost to
+    back-in-device-mode, the bounded window the --device-chaos drill
+    asserts on."""
+    return (
+        registry.counter(
+            "keto_backend_failovers_total",
+            "device-lost / backend-swap events handled by the device "
+            "supervisor",
+        ),
+        registry.histogram(
+            "keto_device_recovery_seconds",
+            "wall time from device-lost to serving in device mode again "
+            "(probe + residency rebuild + re-warmup)",
+            buckets=RECOVERY_BUCKETS,
+        ),
+    )
+
+
 def hedge_counters(registry: MetricsRegistry) -> tuple[Counter, Counter, Counter]:
     """(fired, won, wasted) counters for hedged single-check reads: fired =
     a hedge was issued, won = the hedge answered first, wasted = the

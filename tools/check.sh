@@ -15,7 +15,9 @@
 #      mixed load; answer parity, snaptoken monotonicity, no lost
 #      futures, bounded p99; plus the kill-and-restart drill (SIGKILL at
 #      every WAL/checkpoint fault site, post-recovery parity vs a shadow
-#      oracle)
+#      oracle) and the device-fault drills (--device-chaos: OOM batch
+#      bisection parity, compile-failure quarantine, device-loss
+#      failover with bounded recovery)
 #   4. replication gate — 1 leader + 2 followers in-process: checkpoint
 #      bootstrap + WAL-tail convergence under a lag bound, token-
 #      consistent reads on followers (wait AND bounce paths), read-only
@@ -36,7 +38,7 @@ echo "== bench smoke =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python bench.py --smoke || exit 1
 
 echo "== chaos soak smoke =="
-timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/soak.py --smoke --seed 4 --pool --restart || exit 1
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/soak.py --smoke --seed 4 --pool --restart --device-chaos || exit 1
 
 echo "== replication gate =="
 timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/replication_gate.py || exit 1

@@ -559,6 +559,167 @@ def run_engine_soak(
     return summary
 
 
+def run_device_chaos(seed: int) -> dict:
+    """--device-chaos: the device-fault & memory-pressure drills.
+
+    Three seeded scenarios against one registry, asserting ZERO wrong
+    answers and bounded recovery throughout:
+
+    1. OOM bisection — ``device.oom`` armed 3x against one 120-row
+       columnar batch: every answer must match the host oracle, the
+       breaker must stay closed (no host-fallback escalation), and
+       ``keto_device_oom_bisections_total`` must reach >= 3.
+    2. Compile-failure quarantine — ``device.compile_fail`` armed: the
+       failing shape is absorbed into the quarantine (host oracle answers
+       it) WITHOUT opening the circuit for every other shape.
+    3. Device loss — ``device.lost`` armed: the lost batch is answered by
+       the host oracle, the supervisor runs its failover/re-probe loop,
+       and serving must return to device mode inside a bounded window,
+       visible in the supervisor timeline and the flight recorder.
+    """
+    from keto_tpu.relationtuple.columns import CheckColumns
+
+    recovery_bound_s = 15.0
+    FAULTS.reset()
+    rng = random.Random(seed)
+    violations = _Violations()
+    cfg = Config(
+        values={
+            "namespaces": [{"id": 1, "name": "n"}],
+            "log": {"level": "error"},
+            "engine": {
+                "mode": "device",
+                "max_batch": 256,
+                "cache_size": 0,  # a cache hit would mask device faults
+                "encoded_cache_size": 0,
+                "fallback_threshold": 3,
+                "fallback_cooldown_ms": 100,
+                # inproc probe: the drill runs on the CPU test mesh where
+                # a child re-probe proves nothing and costs a process spawn
+                "failover": {
+                    "probe_mode": "inproc",
+                    "probe_interval_s": 0.05,
+                },
+            },
+        }
+    )
+    reg = Registry(cfg)
+    store = reg.store()
+    true_objs = [f"ok{i}" for i in range(48)]
+    store.transact_relation_tuples([_tup(o) for o in true_objs], [])
+    checker = reg.checker()
+    breaker = reg._engine_breaker
+    supervisor = reg.device_supervisor()
+
+    def counter(name: str) -> float:
+        m = reg.metrics()._metrics.get(name)
+        return float(m.value) if m is not None else 0.0
+
+    def batch(n_rows: int):
+        """(validated CheckColumns, expected answers): half present
+        objects, half ghosts — wrong answers are detectable both ways."""
+        objs, want = [], []
+        for _ in range(n_rows):
+            if rng.random() < 0.5:
+                objs.append(true_objs[rng.randrange(len(true_objs))])
+                want.append(True)
+            else:
+                objs.append(f"ghost{rng.randrange(64)}")
+                want.append(False)
+        cols = CheckColumns(
+            ["n"] * n_rows, objs, ["view"] * n_rows,
+            subject_ids=["alice"] * n_rows,
+        )
+        return cols.validate(), want
+
+    def wrong_count(cols_want, label: str) -> int:
+        cols, want = cols_want
+        got = checker.check_batch_columnar(cols, 5)
+        wrong = sum(1 for g, w in zip(got, want) if bool(g) is not w)
+        if wrong:
+            violations.add(f"{label}: {wrong}/{len(want)} wrong answers")
+        return wrong
+
+    # -- drill 1: OOM bisection ---------------------------------------------
+    fb_before = counter("keto_device_fallback_batches_total")
+    FAULTS.arm("device.oom", times=3)
+    wrong_count(batch(120), "oom drill")
+    bisections = counter("keto_device_oom_bisections_total")
+    if bisections < 3:
+        violations.add(
+            f"oom drill: expected >= 3 bisections, saw {bisections}"
+        )
+    if counter("keto_device_fallback_batches_total") > fb_before:
+        violations.add("oom drill: escalated to host fallback")
+    if breaker.circuit_open():
+        violations.add("oom drill: tripped the breaker")
+
+    # -- drill 2: compile-failure quarantine --------------------------------
+    FAULTS.arm("device.compile_fail")
+    wrong_count(batch(96), "compile-fail drill")  # oracle absorbs the shape
+    if not breaker.quarantine_snapshot():
+        violations.add("compile-fail drill: shape was not quarantined")
+    if breaker.circuit_open():
+        violations.add("compile-fail drill: quarantine opened the circuit")
+    quarantine_size = counter("keto_compile_quarantine_size")
+
+    # -- drill 3: device loss -> failover -> bounded recovery ---------------
+    failovers_before = counter("keto_backend_failovers_total")
+    FAULTS.arm("device.lost")
+    t_lost = time.monotonic()
+    wrong_count(batch(64), "device-lost drill (during loss)")
+    status = None
+    deadline = t_lost + recovery_bound_s
+    while time.monotonic() < deadline:
+        status = supervisor.status() if supervisor is not None else None
+        if (
+            status is not None
+            and status["failovers"] >= 1
+            and not status["recovering"]
+        ):
+            break
+        time.sleep(0.05)
+    else:
+        violations.add(
+            f"device-lost drill: no recovery inside {recovery_bound_s}s "
+            f"(status={status})"
+        )
+    if counter("keto_backend_failovers_total") <= failovers_before:
+        violations.add("device-lost drill: failover counter did not move")
+    # recovery ends with a forced half-open probe: the next batch must be
+    # served by the device again with the circuit closing behind it
+    wrong_count(batch(64), "device-lost drill (after recovery)")
+    if breaker.circuit_open():
+        violations.add("device-lost drill: circuit still open post-recovery")
+    flight = reg.flight()
+    failover_records = [
+        r
+        for r in (flight.records(200) if flight is not None else [])
+        if r.get("kind") == "device_failover"
+    ]
+    if not failover_records:
+        violations.add(
+            "device-lost drill: no device_failover flight records"
+        )
+
+    FAULTS.reset()
+    checker.close()
+    if supervisor is not None:
+        supervisor.stop()
+    return {
+        "phase": "device_chaos",
+        "seed": seed,
+        "oom_bisections": bisections,
+        "compile_quarantine_size": quarantine_size,
+        "failovers": counter("keto_backend_failovers_total"),
+        "last_recovery_s": (
+            status.get("last_recovery_s") if status is not None else None
+        ),
+        "failover_flight_records": len(failover_records),
+        "violations": violations.items,
+    }
+
+
 def run_pool_soak(seed: int, n_rounds: int = 3, per_round: int = 4) -> dict:
     """The fork phase: 3-worker replica pool under delta.drop/delta.slow/
     replica.crash; every committed write must converge to 200 on fresh
@@ -1081,6 +1242,11 @@ def main(argv=None) -> int:
         "--restart", action="store_true",
         help="also run the durable-store kill-and-restart drill",
     )
+    ap.add_argument(
+        "--device-chaos", action="store_true",
+        help="also run the device-fault drills (OOM bisection, compile "
+        "quarantine, device-loss failover)",
+    )
     args = ap.parse_args(argv)
 
     if args.smoke:
@@ -1096,6 +1262,8 @@ def main(argv=None) -> int:
 
     phases = [run_engine_soak(args.seed, n_ops=ops, n_writes=writes,
                               n_faults=faults)]
+    if args.device_chaos:
+        phases.append(run_device_chaos(args.seed))
     if args.pool:
         phases.append(run_pool_soak(args.seed))
     if args.restart:
