@@ -94,6 +94,20 @@ def _heartbeat_path() -> str:
     )
 
 
+def _rotate_heartbeat(path: str) -> None:
+    """Keep the append-only heartbeat log bounded: once it crosses
+    BENCH_HEARTBEAT_MAX_BYTES (default 1 MiB), the current file moves to
+    <path>.1 (replacing any prior rotation) and appends continue on a
+    fresh file — repeated runs never accumulate an unbounded log, and
+    the newest two generations always survive for a post-mortem."""
+    try:
+        cap = int(os.environ.get("BENCH_HEARTBEAT_MAX_BYTES", 1 << 20))
+        if cap > 0 and os.path.getsize(path) >= cap:
+            os.replace(path, path + ".1")
+    except OSError:
+        pass
+
+
 def _heartbeat(phase: str, **extra) -> None:
     """Append a progress line to the heartbeat JSONL. An rc-124 timeout
     kills stdout mid-phase; this file survives and names the phase that
@@ -110,7 +124,9 @@ def _heartbeat(phase: str, **extra) -> None:
     }
     _LAST_PHASE = phase
     try:
-        with open(_heartbeat_path(), "a") as f:
+        path = _heartbeat_path()
+        _rotate_heartbeat(path)
+        with open(path, "a") as f:
             f.write(json.dumps(line) + "\n")
     except OSError:
         pass  # heartbeat is evidence, never a reason to fail the run
@@ -1053,18 +1069,25 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
     )
     rng = np.random.default_rng(11)
 
-    cfg = Config(
-        values={
-            "serve": {
-                "read": {"port": 0, "workers": n_workers},
-                "write": {"port": 0},
-            },
-            # per-request logs at info would spam (and single-core: slow)
-            # the bench; errors still surface
-            "log": {"level": "error"},
+    values = {
+        "serve": {
+            "read": {"port": 0, "workers": n_workers},
+            "write": {"port": 0},
         },
-        env={},
-    )
+        # per-request logs at info would spam (and single-core: slow)
+        # the bench; errors still surface
+        "log": {"level": "error"},
+    }
+    if os.environ.get("BENCH_FEDERATION", "0") == "1":
+        # measure the serving numbers WITH the federation scrape loop
+        # live (standalone self-federation): the acceptance bar is that
+        # grpc_batch_rps stays within noise of a federation-off run
+        values["cluster"] = {
+            "enabled": True,
+            "instance_id": "bench-server",
+            "scrape_interval_ms": 500,
+        }
+    cfg = Config(values=values, env={})
     # quiesce: the replica fork must not race a background closure rebuild
     # left over from the write phase (children would inherit mid-mutation
     # state)
@@ -1477,6 +1500,11 @@ def _smoke_defaults() -> None:
         "BENCH_REPL_SECONDS": "2",
         "BENCH_BUDGET_S": "240",
         "BENCH_PROBE_TIMEOUT_S": "20",
+        # cluster federation ON in the gate: the smoke numbers are
+        # measured with the scrape loop live, so a federation change
+        # that leaks onto the serving path shows up as a vs_prev
+        # regression here, not in production
+        "BENCH_FEDERATION": "1",
     }.items():
         os.environ.setdefault(k, v)
 
@@ -1802,8 +1830,10 @@ def run_replicated_bench() -> None:
                 self.loop.call_soon_threadsafe(self.loop.stop)
                 self.thread.join(timeout=5)
 
-    def base(extra):
-        return {
+    federation = os.environ.get("BENCH_FEDERATION", "0") == "1"
+
+    def base(extra, instance_id=""):
+        values = {
             "namespaces": [{"id": 1, "name": "n"}],
             "log": {"level": "error"},
             "engine": {"mode": "host"},
@@ -1813,6 +1843,14 @@ def run_replicated_bench() -> None:
             },
             **extra,
         }
+        if federation:
+            values["cluster"] = {
+                "enabled": True,
+                "instance_id": instance_id,
+                "heartbeat_interval_ms": 250,
+                "scrape_interval_ms": 500,
+            }
+        return values
 
     nodes = []
     try:
@@ -1824,7 +1862,8 @@ def run_replicated_bench() -> None:
                     "replication": {
                         "role": "leader", "poll_interval_ms": 10,
                     },
-                }
+                },
+                instance_id="bench-leader",
             )
         )
         nodes.append(leader)
@@ -1841,7 +1880,8 @@ def run_replicated_bench() -> None:
                             "dir": os.path.join(root, f"f{i}"),
                             "poll_interval_ms": 10,
                         },
-                    }
+                    },
+                    instance_id=f"bench-follower-{i}",
                 )
             )
             for i in range(2)
@@ -1905,6 +1945,33 @@ def run_replicated_bench() -> None:
             "lag_versions": [p["lag_versions"] for p in panels],
             "applied_total": [p["applied_total"] for p in panels],
         }
+        if federation:
+            # the leader's fleet view should have seen all three members
+            # by now (heartbeats every 250ms over the whole load window)
+            import urllib.request
+
+            cluster_members = 0
+            cluster_health = None
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{leader.read_port}"
+                        "/cluster/status",
+                        timeout=5,
+                    ) as resp:
+                        cs = json.loads(resp.read().decode("utf-8"))
+                    cluster_members = int(
+                        cs.get("cluster", {}).get("alive", 0)
+                    )
+                    cluster_health = cs.get("cluster", {}).get("health")
+                    if cluster_members >= 1 + len(followers):
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.25)
+            summary["cluster_members"] = cluster_members
+            summary["cluster_health"] = cluster_health
         print(
             json.dumps({"config": "replicated_read", **summary}),
             file=sys.stderr,
@@ -2216,9 +2283,12 @@ def main():
                 "value": None,
                 "unit": "checks/s",
                 "truncated": True,
-                **_EXTRA_HEADLINE,
                 **(backend_meta or {}),
             }
+            vs_prev, regressions = _trajectory(line)
+            line["vs_prev"] = vs_prev
+            line["regressions"] = regressions
+            line.update(_EXTRA_HEADLINE)
             global _LAST_HEADLINE
             _LAST_HEADLINE = json.dumps(line)
             print(_LAST_HEADLINE, flush=True)
@@ -2252,6 +2322,79 @@ def main():
                     flush=True,
                 )
                 sys.exit(3)
+
+
+def _load_prev_headline() -> tuple[str, dict] | None:
+    """The previous run's headline: newest BENCH_r*.json on disk whose
+    stderr tail still contains a parseable summary line (a JSON object
+    with a "metric" key). Runs that died without a headline (r05's
+    rc=124) are skipped — the trajectory compares against the last run
+    that actually reported."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in sorted(
+        glob.glob(os.path.join(here, "BENCH_r*.json")), reverse=True
+    ):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for raw in reversed((doc.get("tail") or "").splitlines()):
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                return os.path.basename(path), obj
+    return None
+
+
+_HIGHER_BETTER = ("value", "grpc_batch_rps", "batch_rps", "device_check_rps")
+_LOWER_BETTER = ("batch_p95_ms", "expand_p95_ms", "staleness_p95_ms")
+
+
+def _trajectory(line: dict) -> tuple[dict | None, list[str]]:
+    """Cross-run comparison for the final headline: per-metric deltas vs
+    the previous run's headline, plus the metrics that regressed >20% in
+    the bad direction. Regressions are only flagged when the runs are
+    comparable (same config rung and backend) — a smoke run is not a
+    regression against a full ladder."""
+    prev = _load_prev_headline()
+    if prev is None:
+        return None, []
+    source, prev_line = prev
+    config_match = prev_line.get("config") == line.get(
+        "config"
+    ) and prev_line.get("backend") == line.get("backend")
+    deltas = {}
+    regressions = []
+    for key in _HIGHER_BETTER + _LOWER_BETTER:
+        a, b = prev_line.get(key), line.get(key)
+        if (
+            not isinstance(a, (int, float))
+            or not isinstance(b, (int, float))
+            or isinstance(a, bool)
+            or isinstance(b, bool)
+            or a == 0
+        ):
+            continue
+        pct = round((b - a) / a * 100.0, 1)
+        deltas[key] = {"prev": a, "now": b, "delta_pct": pct}
+        if config_match:
+            worse = pct < -20.0 if key in _HIGHER_BETTER else pct > 20.0
+            if worse:
+                regressions.append(key)
+    return {
+        "source": source,
+        "prev_config": prev_line.get("config"),
+        "config_match": config_match,
+        "deltas": deltas,
+    }, regressions
 
 
 def _print_primary(results, backend_meta=None):
@@ -2326,9 +2469,14 @@ def _print_primary(results, backend_meta=None):
         # true when the budget scheduler skipped any phase: the numbers
         # are valid but the ladder is incomplete (see skip lines on stderr)
         "truncated": _TRUNCATED,
-        **_EXTRA_HEADLINE,
         **(backend_meta or {}),
     }
+    # cross-run trajectory: deltas vs the previous BENCH_r*.json headline
+    # (backend must be merged first — comparability checks it)
+    vs_prev, regressions = _trajectory(line)
+    line["vs_prev"] = vs_prev
+    line["regressions"] = regressions
+    line.update(_EXTRA_HEADLINE)
     global _LAST_HEADLINE
     _LAST_HEADLINE = json.dumps(line)
     print(_LAST_HEADLINE, flush=True)

@@ -5,7 +5,11 @@ Routes (all under /debug, read port only):
 - ``/debug/stacks``   every thread's Python stack, plain text
 - ``/debug/graph``    graph panel + device samples (telemetry/devstats.py)
 - ``/debug/flight``   the request flight-recorder ring, newest first
-- ``/debug/traces``   the tracer's finished-span ring (hex ids)
+- ``/debug/traces``   the tracer's finished-span ring (hex ids);
+  ``?trace_id=`` filters to one trace and adds matching flight records,
+  and on a cluster leader fans out to every member and stitches the
+  per-process results into one cross-node timeline (``&local=1``
+  suppresses the fan-out — it is what the leader sends the members)
 - ``/debug/config``   effective config with secret redaction
 - ``/debug/profile``  ?seconds=N jax.profiler capture, returned as .tar.gz
 - ``/debug/attribution``  where check wall time goes: the accounting
@@ -17,6 +21,8 @@ Routes (all under /debug, read port only):
   capture when the profiler is not already running
 - ``/debug/device``   device-fault plane: serving backend, breaker +
   quarantined shapes, last failover timeline, HBM budget headroom
+- ``/debug/cluster``  fleet view: the federation scraper's full status
+  (per-member health rollup + scrape/heartbeat internals), leader only
 
 Gating: ``debug.enabled: false`` hides the whole surface as 404 (the
 routes do not exist as far as a prober can tell); ``debug.token`` set
@@ -118,6 +124,8 @@ class DebugContext:
         profiler=None,
         build_phases_fn=None,
         device_status_fn=None,
+        cluster=None,
+        instance_id: str = "",
     ):
         self.config = config
         self.flight = flight
@@ -139,6 +147,13 @@ class DebugContext:
         # serving backend, breaker/quarantine state, failover timeline,
         # and HBM budget headroom (driver/registry.py _device_status)
         self.device_status_fn = device_status_fn
+        # PR10 fleet-observability plane: the leader's FederationScraper
+        # (member discovery for the trace-stitch fan-out + /debug/cluster
+        # status) and this node's own instance id, stamped on every span
+        # and flight record returned from /debug/traces so stitched
+        # timelines attribute each entry to its process
+        self.cluster = cluster
+        self.instance_id = instance_id or ""
 
 
 class DebugAPI:
@@ -156,6 +171,7 @@ class DebugAPI:
         app.router.add_get("/debug/attribution", self.get_attribution)
         app.router.add_get("/debug/pprof", self.get_pprof)
         app.router.add_get("/debug/device", self.get_device)
+        app.router.add_get("/debug/cluster", self.get_cluster)
 
     # -- gate -----------------------------------------------------------------
 
@@ -203,22 +219,22 @@ class DebugAPI:
             payload["checks"] = self.ctx.check_telemetry.stats()
         return web.json_response(payload, dumps=_dumps)
 
-    async def get_traces(self, request: web.Request) -> web.Response:
-        self._gate(request)
+    def _local_trace_view(
+        self, name, trace_id: Optional[str], n: int
+    ) -> dict:
+        """This process's spans (and, for a trace_id query, matching
+        flight records) — the per-member half of the stitched view."""
         tracer = self.ctx.tracer
-        q = request.rel_url.query
-        name = q.get("name") or None
-        try:
-            n = int(q.get("n", "100"))
-        except ValueError:
-            n = 100
         spans = []
         if tracer is not None:
-            for s in tracer.finished(name)[-n:]:
+            for s in tracer.finished(name):
+                tid = f"{s.trace_id:032x}"
+                if trace_id is not None and tid != trace_id:
+                    continue
                 spans.append(
                     {
                         "name": s.name,
-                        "trace_id": f"{s.trace_id:032x}",
+                        "trace_id": tid,
                         "span_id": f"{s.span_id:016x}",
                         "parent_id": (
                             f"{s.parent_id:016x}" if s.parent_id else None
@@ -226,10 +242,155 @@ class DebugAPI:
                         "start": s.start,
                         "duration_ms": round((s.duration or 0) * 1000, 3),
                         "attrs": dict(s.attrs),
+                        "instance": self.ctx.instance_id or None,
                     }
                 )
+        spans = spans[-n:]
         spans.reverse()  # newest first, matching /debug/flight
-        return web.json_response({"spans": spans}, dumps=_dumps)
+        payload: dict = {"spans": spans}
+        if self.ctx.instance_id:
+            payload["instance"] = self.ctx.instance_id
+        if trace_id is not None:
+            flight = self.ctx.flight
+            records = []
+            if flight is not None:
+                for rec in flight.records(None):
+                    if rec.get("trace_id") == trace_id:
+                        rec = dict(rec)
+                        rec["instance"] = self.ctx.instance_id or None
+                        records.append(rec)
+            payload["flight"] = records
+        return payload
+
+    async def _stitch_cluster_trace(
+        self, trace_id: str, n: int, local: dict
+    ) -> dict:
+        """Fan /debug/traces?trace_id=&local=1 out to every alive member
+        and merge the per-process spans + flight records into one
+        timeline. A hedged pair (one traceparent, two endpoints raced)
+        comes back as a single view: both check.request spans under the
+        same trace id, each tagged with its instance, the winner being
+        the attempt that finished first."""
+        import json as _json
+        import urllib.request
+
+        cluster = self.ctx.cluster
+        me = self.ctx.instance_id
+        per_instance: dict[str, dict] = {}
+        if me:
+            per_instance[me] = local
+        loop = asyncio.get_running_loop()
+
+        def fetch(url: str) -> dict:
+            req = urllib.request.Request(
+                f"{url}/debug/traces?trace_id={trace_id}&local=1&n={n}"
+            )
+            if self.ctx.token:
+                req.add_header("X-Debug-Token", self.ctx.token)
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return _json.loads(resp.read().decode("utf-8"))
+
+        targets = [
+            (instance, url)
+            for instance, url in cluster.member_read_urls()
+            if instance != me
+        ]
+        results = await asyncio.gather(
+            *(
+                loop.run_in_executor(None, fetch, url)
+                for _, url in targets
+            ),
+            return_exceptions=True,
+        )
+        errors = {}
+        for (instance, _), res in zip(targets, results):
+            if isinstance(res, BaseException):
+                errors[instance] = f"{type(res).__name__}: {res}"
+                continue
+            for span in res.get("spans", []):
+                span.setdefault("instance", instance)
+            for rec in res.get("flight", []):
+                rec.setdefault("instance", instance)
+            per_instance[instance] = res
+        spans = [
+            s
+            for view in per_instance.values()
+            for s in view.get("spans", [])
+        ]
+        records = [
+            r
+            for view in per_instance.values()
+            for r in view.get("flight", [])
+        ]
+        timeline = sorted(
+            [
+                {
+                    "kind": "span",
+                    "instance": s.get("instance"),
+                    "name": s["name"],
+                    "start": s["start"],
+                    "end": s["start"] + s["duration_ms"] / 1000.0,
+                    "duration_ms": s["duration_ms"],
+                    "hedge": bool((s.get("attrs") or {}).get("hedge")),
+                    "attrs": s.get("attrs"),
+                }
+                for s in spans
+            ],
+            key=lambda e: e["start"],
+        )
+        # which endpoint won the hedge race: among the check.request
+        # spans of this trace, the attempt that COMPLETED first
+        checks = [e for e in timeline if e["name"] == "check.request"]
+        winner = None
+        if checks:
+            first_done = min(checks, key=lambda e: e["end"])
+            winner = {
+                "instance": first_done["instance"],
+                "hedge": first_done["hedge"],
+                "duration_ms": first_done["duration_ms"],
+            }
+        return {
+            "trace_id": trace_id,
+            "stitched": True,
+            "instances": sorted(per_instance),
+            "spans": spans,
+            "flight": records,
+            "timeline": timeline,
+            "hedge": {
+                "attempts": len(checks),
+                "hedged": any(e["hedge"] for e in checks),
+                "winner": winner,
+            },
+            "errors": errors or None,
+        }
+
+    async def get_traces(self, request: web.Request) -> web.Response:
+        self._gate(request)
+        q = request.rel_url.query
+        name = q.get("name") or None
+        trace_id = (q.get("trace_id") or "").strip().lower() or None
+        local = q.get("local") == "1"
+        try:
+            n = int(q.get("n", "100"))
+        except ValueError:
+            n = 100
+        payload = self._local_trace_view(name, trace_id, n)
+        if trace_id is not None and not local and self.ctx.cluster is not None:
+            payload = await self._stitch_cluster_trace(trace_id, n, payload)
+        return web.json_response(payload, dumps=_dumps)
+
+    async def get_cluster(self, request: web.Request) -> web.Response:
+        """The federation scraper's full fleet status — /cluster/status
+        plus scrape internals, behind the debug gate."""
+        self._gate(request)
+        cluster = self.ctx.cluster
+        if cluster is None:
+            return web.json_response(
+                {"error": "not a cluster leader (cluster.enabled off or "
+                          "this node is a follower)"},
+                status=404,
+            )
+        return web.json_response(cluster.status(), dumps=_dumps)
 
     async def get_config(self, request: web.Request) -> web.Response:
         self._gate(request)

@@ -673,6 +673,7 @@ def build_read_app(
     cors: Optional[dict] = None, healthy_fn=None, executor=None,
     logger=None, metrics=None, telemetry=None, debug=None,
     version_waiter=None, max_freshness_wait_s=30.0,
+    cluster_status_fn=None,
 ) -> web.Application:
     # telemetry outermost (sees final codes), then CORS so error
     # responses also carry the headers
@@ -689,6 +690,15 @@ def build_read_app(
         max_freshness_wait_s=max_freshness_wait_s,
     ).register(app)
     register_common(app, version, healthy_fn, metrics)
+    if cluster_status_fn is not None:
+        # fleet health rollup, public like /metrics — the federation
+        # scraper keeps it a cached-dict read, never an inline scrape
+        async def cluster_status(_request):
+            return web.json_response(
+                json.loads(json.dumps(cluster_status_fn(), default=str))
+            )
+
+        app.router.add_get("/cluster/status", cluster_status)
     if debug is not None:
         # /debug lives on the read plane only; the DebugContext gates
         # enablement and token auth per request
@@ -703,6 +713,7 @@ def build_write_app(
     cors: Optional[dict] = None, healthy_fn=None,
     logger=None, metrics=None,
     read_only: bool = False, replication_source=None,
+    cluster_membership=None, replication_status_fn=None,
 ) -> web.Application:
     app = web.Application(
         middlewares=[
@@ -719,4 +730,29 @@ def build_write_app(
         # operator-facing port, and replication traffic must not contend
         # with read-plane checks.
         replication_source.register(app)
+    elif replication_status_fn is not None:
+        # follower: no WAL to serve, but the federation scraper still
+        # wants a /replication/status on every member's write plane
+        async def repl_status(_request):
+            return web.json_response(
+                json.loads(json.dumps(replication_status_fn(), default=str))
+            )
+
+        app.router.add_get("/replication/status", repl_status)
+    if cluster_membership is not None:
+        # leader: followers heartbeat here, over the same plane they
+        # already pull WAL from
+        async def heartbeat(request):
+            try:
+                payload = await request.json()
+                if not isinstance(payload, dict):
+                    raise ValueError("heartbeat body must be an object")
+                row = cluster_membership.upsert(payload)
+            except Exception as e:
+                raise ErrMalformedInput(str(e))
+            return web.json_response(
+                {"ok": True, "heartbeats": row["heartbeats"]}
+            )
+
+        app.router.add_post("/cluster/heartbeat", heartbeat)
     return app

@@ -475,12 +475,20 @@ def debug():
     "--timeout", "timeout_s", default=10.0, show_default=True,
     help="per-endpoint fetch timeout in seconds",
 )
+@click.option(
+    "--cluster", "cluster_bundle", is_flag=True,
+    help="aggregate a support bundle from EVERY cluster member "
+         "(discovered via the leader's /cluster/status), one "
+         "cluster/<instance_id>/ subtree per member",
+)
 @click.pass_context
-def debug_snapshot(ctx, url, out, token, timeout_s):
+def debug_snapshot(ctx, url, out, token, timeout_s, cluster_bundle):
     """Bundle a support tarball from a live server: thread stacks,
     redacted config, graph panel + device stats, the flight-recorder
     ring, recent traces, a metrics dump, and pipeline occupancy. Safe to
-    attach to a ticket — /debug/config redacts secrets server-side."""
+    attach to a ticket — /debug/config redacts secrets server-side.
+    With --cluster, also walks the leader's membership table and pulls
+    the same bundle from every alive member."""
     import io
     import tarfile
     import urllib.error
@@ -499,15 +507,43 @@ def debug_snapshot(ctx, url, out, token, timeout_s):
     ]
     fetched: list[tuple[str, bytes]] = []
     errors: list[str] = []
-    for name, path in endpoints:
-        req = urllib.request.Request(base + path)
-        if token:
-            req.add_header("X-Debug-Token", token)
+
+    def pull(base_url: str, prefix: str = "") -> None:
+        for name, path in endpoints:
+            req = urllib.request.Request(base_url + path)
+            if token:
+                req.add_header("X-Debug-Token", token)
+            try:
+                with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                    fetched.append((prefix + name, resp.read()))
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                errors.append(f"{prefix}{path}: {e}")
+
+    pull(base)
+    if cluster_bundle:
+        import json as _json
+
         try:
-            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-                fetched.append((name, resp.read()))
+            with urllib.request.urlopen(
+                base + "/cluster/status", timeout=timeout_s
+            ) as resp:
+                cluster_status = resp.read()
+            fetched.append(("cluster_status.json", cluster_status))
+            members = _json.loads(cluster_status.decode("utf-8")).get(
+                "members", []
+            )
         except (urllib.error.URLError, OSError, ValueError) as e:
-            errors.append(f"{path}: {e}")
+            members = []
+            errors.append(f"/cluster/status: {e}")
+        for m in members:
+            member_url = (m.get("read_url") or "").rstrip("/")
+            instance = m.get("instance_id") or "unknown"
+            if not member_url or member_url == base:
+                continue
+            if not m.get("alive", True):
+                errors.append(f"cluster/{instance}: member down, skipped")
+                continue
+            pull(member_url, prefix=f"cluster/{instance}/")
     if not fetched:
         raise click.ClickException(
             f"could not reach {base} — " + "; ".join(errors[:3])
@@ -687,12 +723,55 @@ def namespace_migrate_status(namespace_name, config_file):
 @click.option("--block", is_flag=True, help="wait until the server is SERVING")
 @click.option("--timeout", "timeout_s", default=0, type=float,
               help="give up after this many seconds (0 = forever)")
+@click.option("--cluster", "cluster_view", is_flag=True,
+              help="show the leader's fleet view (/cluster/status) "
+                   "instead of the local health probe")
 @click.pass_context
-def status(ctx, block, timeout_s):
+def status(ctx, block, timeout_s, cluster_view):
     """Health of the read API; --block watches until SERVING
-    (reference cmd/status/root.go:28-110)."""
+    (reference cmd/status/root.go:28-110). With --cluster, asks the
+    leader's /cluster/status for the per-member green/yellow/red rollup
+    (replication lag, SLO burn, breaker state, heartbeat liveness)."""
     from ..api import health_pb2
     from ..api.services import HealthStub
+
+    if cluster_view:
+        import json as _json
+        import urllib.request
+
+        url = f"http://{_read_remote(ctx)}/cluster/status"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                payload = _json.loads(resp.read().decode("utf-8"))
+        except OSError as e:
+            raise click.ClickException(f"could not fetch {url}: {e}")
+        summary = payload.get("cluster", {})
+        click.echo(
+            f"cluster: {summary.get('health', '?')} "
+            f"({summary.get('alive', '?')}/{summary.get('members', '?')} "
+            f"alive, aggregate burn "
+            f"{summary.get('aggregate_burn_rate', '?')})"
+        )
+        for m in payload.get("members", []):
+            lag = m.get("lag_versions")
+            burn = m.get("burn_rate")
+            line = (
+                f"  {m.get('health', '?'):6s} "
+                f"{m.get('instance_id', '?')} "
+                f"role={m.get('role', '?')} "
+                f"alive={m.get('alive')} "
+                f"lag_versions={lag if lag is not None else '?'} "
+                f"burn={burn if burn is not None else '?'} "
+                f"qps={m.get('qps') if m.get('qps') is not None else '?'}"
+            )
+            reasons = m.get("reasons") or []
+            if reasons:
+                line += "  [" + "; ".join(reasons) + "]"
+            click.echo(line)
+        worst = summary.get("health")
+        if worst == "red":
+            sys.exit(1)
+        return
 
     deadline = time.monotonic() + timeout_s if timeout_s else None
     while True:

@@ -1,0 +1,15 @@
+"""Cluster fleet-observability plane.
+
+PR 8 turned the daemon into a leader/follower fleet; this package gives
+that fleet one pane of glass. Followers push heartbeats to the leader
+over the replication plane (:class:`ClusterHeartbeater`), the leader
+tracks liveness per instance (:class:`ClusterMembership`), and
+telemetry/federation.py scrapes each member's ``/metrics`` +
+``/replication/status`` into instance-labeled ``keto_cluster_*`` series
+plus the ``/cluster/status`` health rollup.
+"""
+
+from .heartbeat import ClusterHeartbeater
+from .membership import ClusterMembership
+
+__all__ = ["ClusterHeartbeater", "ClusterMembership"]

@@ -1,0 +1,111 @@
+"""Follower-side heartbeater.
+
+A daemon thread that POSTs the node's self-describing payload (role,
+instance id, snapshot version, backend, breaker/quarantine state, HBM
+inflight, SLO burn, advertised URLs) to the leader's write plane at
+``/cluster/heartbeat`` every ``interval_s``. Rides the same upstream URL
+the WAL-tail replicator already uses, so a follower that can replicate
+can heartbeat — no extra discovery surface.
+
+Failures are swallowed and counted: the heartbeater must never take a
+serving node down because the leader is restarting. ``status()`` exposes
+beat/error counts and the last error for ``/cluster``-side debugging.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Callable, Optional
+
+
+class ClusterHeartbeater:
+    def __init__(
+        self,
+        upstream: str,
+        payload_fn: Callable[[], dict],
+        interval_s: float = 1.0,
+        timeout_s: float = 5.0,
+        logger=None,
+        post_fn=None,  # injectable for tests: post_fn(url, payload_dict)
+    ):
+        self.upstream = upstream.rstrip("/")
+        self.url = f"{self.upstream}/cluster/heartbeat"
+        self._payload_fn = payload_fn
+        self.interval_s = max(0.01, float(interval_s))
+        self.timeout_s = float(timeout_s)
+        self._logger = logger
+        self._post_fn = post_fn or self._post
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.beats = 0
+        self.errors = 0
+        self.last_error: Optional[str] = None
+        self.last_beat_t: Optional[float] = None
+
+    def _post(self, url: str, payload: dict) -> None:
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(payload).encode("utf-8"),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=self.timeout_s).read()
+
+    def beat_once(self) -> bool:
+        """One heartbeat attempt; True on success. Used by the loop and
+        directly by tests."""
+        try:
+            payload = self._payload_fn()
+            self._post_fn(self.url, payload)
+        except Exception as e:
+            self.errors += 1
+            self.last_error = f"{type(e).__name__}: {e}"
+            if self._logger is not None and self.errors in (1, 10, 100):
+                try:
+                    self._logger.warning(
+                        "cluster_heartbeat_error",
+                        upstream=self.upstream,
+                        errors=self.errors,
+                        error=self.last_error,
+                    )
+                except Exception:
+                    pass
+            return False
+        self.beats += 1
+        self.last_beat_t = time.time()
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.beat_once()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="keto-cluster-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.timeout_s + self.interval_s)
+            self._thread = None
+
+    def status(self) -> dict:
+        return {
+            "upstream": self.upstream,
+            "interval_s": self.interval_s,
+            "beats": self.beats,
+            "errors": self.errors,
+            "last_error": self.last_error,
+            "last_beat_t": self.last_beat_t,
+            "running": self._thread is not None,
+        }

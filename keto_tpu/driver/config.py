@@ -430,6 +430,56 @@ CONFIG_SCHEMA = {
             },
             "additionalProperties": False,
         },
+        # fleet observability (cluster/, telemetry/federation.py):
+        # followers heartbeat to the leader, the leader scrapes every
+        # member into instance-labeled keto_cluster_* series and the
+        # /cluster/status health rollup
+        "cluster": {
+            "type": "object",
+            "properties": {
+                "enabled": {"type": "boolean"},
+                # metrics label + membership key; defaults to
+                # "<role-or-leader>-<write-port>" when empty
+                "instance_id": {"type": "string"},
+                # how other members reach this node; default to the
+                # loopback URLs of the bound serve ports
+                "advertise_url": {"type": "string"},
+                "advertise_write_url": {"type": "string"},
+                "heartbeat_interval_ms": {"type": "number", "minimum": 10},
+                "scrape_interval_ms": {"type": "number", "minimum": 10},
+                # heartbeats older than this mark the member down
+                "member_timeout_s": {"type": "number", "minimum": 0.1},
+                # green/yellow/red rollup thresholds (federation.py
+                # rollup_health); red >= yellow is the operator's job
+                "health": {
+                    "type": "object",
+                    "properties": {
+                        "lag_versions_yellow": {
+                            "type": "integer", "minimum": 0
+                        },
+                        "lag_versions_red": {
+                            "type": "integer", "minimum": 0
+                        },
+                        "lag_seconds_yellow": {
+                            "type": "number", "minimum": 0
+                        },
+                        "lag_seconds_red": {
+                            "type": "number", "minimum": 0
+                        },
+                        "staleness_yellow_s": {
+                            "type": "number", "minimum": 0
+                        },
+                        "staleness_red_s": {
+                            "type": "number", "minimum": 0
+                        },
+                        "burn_yellow": {"type": "number", "minimum": 0},
+                        "burn_red": {"type": "number", "minimum": 0},
+                    },
+                    "additionalProperties": False,
+                },
+            },
+            "additionalProperties": False,
+        },
     },
     "additionalProperties": False,
 }
@@ -513,6 +563,21 @@ DEFAULTS = {
     "debug.enabled": True,
     "debug.token": "",
     "debug.profile_max_s": 30,
+    "cluster.enabled": False,
+    "cluster.instance_id": "",
+    "cluster.advertise_url": "",
+    "cluster.advertise_write_url": "",
+    "cluster.heartbeat_interval_ms": 1000,
+    "cluster.scrape_interval_ms": 2000,
+    "cluster.member_timeout_s": 10.0,
+    "cluster.health.lag_versions_yellow": 100,
+    "cluster.health.lag_versions_red": 10000,
+    "cluster.health.lag_seconds_yellow": 5.0,
+    "cluster.health.lag_seconds_red": 30.0,
+    "cluster.health.staleness_yellow_s": 10.0,
+    "cluster.health.staleness_red_s": 60.0,
+    "cluster.health.burn_yellow": 1.0,
+    "cluster.health.burn_red": 2.0,
 }
 
 

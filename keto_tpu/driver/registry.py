@@ -332,6 +332,15 @@ class Registry:
         self._replication_source = None
         self._replicator = None
         self._qos = None
+        # cluster fleet-observability plane (cluster/, telemetry/
+        # federation.py): membership + federation on the leader,
+        # heartbeater on followers
+        self._cluster_membership = None
+        self._cluster_heartbeater = None
+        self._federation = None
+        self._cluster_instance_id = ""
+        self._bound_read_port = 0
+        self._bound_write_port = 0
         self.health = HealthServicer()
         self.version = __version__
         self._read_plane: Optional[PlaneServer] = None
@@ -635,6 +644,12 @@ class Registry:
                 profiler=self.profiler(),
                 build_phases_fn=self._build_phases,
                 device_status_fn=self._device_status,
+                cluster=self.federation(),
+                instance_id=(
+                    self.cluster_instance_id()
+                    if self.cluster_enabled()
+                    else ""
+                ),
             )
         return self._debug_context
 
@@ -1135,6 +1150,185 @@ class Registry:
         rep = self.replicator()
         return rep.wait_for_version if rep is not None else None
 
+    # -- cluster fleet observability -------------------------------------------
+
+    def cluster_enabled(self) -> bool:
+        return bool(self.config.get("cluster.enabled", default=False))
+
+    def cluster_instance_id(self) -> str:
+        """This node's stable identity: the membership key and the
+        ``instance`` label on every federated series. Defaults to
+        ``<role>-<random>`` — the suffix matters because a gate or bench
+        boots several same-role nodes on ephemeral ports in one
+        process, and colliding ids would merge their rows."""
+        if not self._cluster_instance_id:
+            iid = str(
+                self.config.get("cluster.instance_id", default="") or ""
+            )
+            if not iid:
+                import uuid
+
+                role = self.replication_role() or "leader"
+                iid = f"{role}-{uuid.uuid4().hex[:6]}"
+            self._cluster_instance_id = iid
+        return self._cluster_instance_id
+
+    def _cluster_url(self, plane: str) -> str:
+        """How other members reach this node's ``plane``:
+        cluster.advertise_url / advertise_write_url when set, else the
+        loopback URL of the bound port (right for the in-process gates
+        and single-host fleets; multi-host deployments must advertise)."""
+        key = (
+            "cluster.advertise_url"
+            if plane == "read"
+            else "cluster.advertise_write_url"
+        )
+        url = str(self.config.get(key, default="") or "")
+        if url:
+            return url.rstrip("/")
+        if plane == "read":
+            host = self.config.read_api_host()
+            port = self._bound_read_port or self.config.read_api_port()
+        else:
+            host = self.config.write_api_host()
+            port = self._bound_write_port or self.config.write_api_port()
+        if host in ("", "0.0.0.0", "::"):
+            host = "127.0.0.1"
+        return f"http://{host}:{port}"
+
+    def _cluster_self_payload(self) -> dict:
+        """The heartbeat body: everything the fleet view wants to know
+        about this node without scraping it. Reads only already-built
+        components (same discipline as _device_status)."""
+        import time as _time
+
+        store = self.store()
+        payload: dict = {
+            "instance_id": self.cluster_instance_id(),
+            "role": self.replication_role() or "leader",
+            "version": store.version,
+            "read_url": self._cluster_url("read"),
+            "write_url": self._cluster_url("write"),
+            "t": _time.time(),
+        }
+        try:
+            payload["served_version"] = self._served_version()
+        except Exception:
+            pass
+        device = self._device_status()
+        payload["backend"] = device.get("backend")
+        sup = device.get("supervisor")
+        if sup:
+            payload["supervisor"] = {
+                "recovering": sup.get("recovering"),
+                "failovers": sup.get("failovers"),
+            }
+        if device.get("breaker") is not None:
+            payload["breaker"] = device["breaker"]
+        if device.get("quarantine") is not None:
+            payload["quarantine_size"] = len(device["quarantine"])
+        if device.get("hbm") is not None:
+            payload["hbm"] = {
+                "inflight_bytes": device["hbm"].get("inflight_bytes"),
+                "inflight_batches": device["hbm"].get("inflight_batches"),
+            }
+        if self._slo is not None:
+            snap = self._slo.snapshot()
+            payload["slo"] = {
+                "fast": snap.get("fast"),
+                "slow": snap.get("slow"),
+                "budget_remaining": snap.get("budget_remaining"),
+            }
+        rep = self._replicator
+        if rep is not None:
+            lag = rep.lag()
+            payload["lag_versions"] = lag.get("lag_versions")
+            payload["staleness_seconds"] = lag.get("staleness_seconds")
+        return payload
+
+    def cluster_membership(self):
+        """Leader-side (and standalone: a node federates itself so a
+        one-box deployment still gets the keto_cluster_* series and
+        /cluster/status) heartbeat table. None on followers or when
+        cluster.enabled is off."""
+        if (
+            self._cluster_membership is None
+            and self.cluster_enabled()
+            and self.replication_role() in ("", "leader")
+        ):
+            from ..cluster import ClusterMembership
+
+            self._cluster_membership = ClusterMembership(
+                member_timeout_s=float(
+                    self.config.get("cluster.member_timeout_s", default=10.0)
+                ),
+            )
+        return self._cluster_membership
+
+    def federation(self):
+        """The leader's federation scraper: membership → per-member
+        /metrics + /replication/status scrapes → instance-labeled
+        keto_cluster_* series + the /cluster/status rollup. None
+        wherever cluster_membership() is None."""
+        membership = self.cluster_membership()
+        if self._federation is None and membership is not None:
+            from ..telemetry.federation import (
+                DEFAULT_THRESHOLDS,
+                FederationScraper,
+            )
+
+            thresholds = {
+                key: self.config.get(
+                    f"cluster.health.{key}", default=default
+                )
+                for key, default in DEFAULT_THRESHOLDS.items()
+            }
+            self._federation = FederationScraper(
+                membership,
+                self.metrics(),
+                scrape_interval_s=float(
+                    self.config.get(
+                        "cluster.scrape_interval_ms", default=2000
+                    )
+                )
+                / 1e3,
+                thresholds=thresholds,
+                objective=float(
+                    self.config.get(
+                        "telemetry.slo.objective", default=0.999
+                    )
+                ),
+                self_payload_fn=self._cluster_self_payload,
+                logger=self.logger(),
+            )
+        return self._federation
+
+    def cluster_heartbeater(self):
+        """The follower's push side: beats this node's payload to the
+        leader's write plane (the replication upstream). None off-follower
+        or when cluster.enabled is off."""
+        if (
+            self._cluster_heartbeater is None
+            and self.cluster_enabled()
+            and self.replication_role() == "follower"
+        ):
+            upstream = str(self.config.get("replication.upstream") or "")
+            if upstream:
+                from ..cluster import ClusterHeartbeater
+
+                self._cluster_heartbeater = ClusterHeartbeater(
+                    upstream,
+                    self._cluster_self_payload,
+                    interval_s=float(
+                        self.config.get(
+                            "cluster.heartbeat_interval_ms", default=1000
+                        )
+                    )
+                    / 1e3,
+                    logger=self.logger(),
+                )
+        return self._cluster_heartbeater
+
     def qos(self):
         """Per-tenant token-bucket admission (engine/qos.py), handed to
         the CheckBatcher's entry points. None unless qos.enabled."""
@@ -1244,6 +1438,11 @@ class Registry:
                 debug=self.debug_context(),
                 version_waiter=self.version_waiter(),
                 max_freshness_wait_s=self._freshness_cap_s,
+                cluster_status_fn=(
+                    self.federation().status
+                    if self.federation() is not None
+                    else None
+                ),
             )
             self._read_plane = PlaneServer(
                 grpc_server,
@@ -1298,6 +1497,12 @@ class Registry:
                 metrics=self.metrics(),
                 read_only=self.replication_role() == "follower",
                 replication_source=self.replication_source(),
+                cluster_membership=self.cluster_membership(),
+                replication_status_fn=(
+                    self.replicator().lag
+                    if self.replicator() is not None
+                    else None
+                ),
             )
             self._write_plane = PlaneServer(
                 grpc_server,
@@ -1506,6 +1711,22 @@ class Registry:
             )
         read_port = await self.read_plane().start()
         write_port = await self.write_plane().start()
+        # cluster plane comes up only once the bound ports are known —
+        # the self payload / heartbeats advertise real URLs, never :0
+        self._bound_read_port, self._bound_write_port = read_port, write_port
+        if self.cluster_enabled():
+            hb = self.cluster_heartbeater()
+            if hb is not None:
+                hb.start()
+            fed = self.federation()
+            if fed is not None:
+                fed.start()
+            log.info(
+                "cluster plane started",
+                instance_id=self.cluster_instance_id(),
+                role=self.replication_role() or "leader",
+                federation=fed is not None,
+            )
         self._start_config_watcher()
         if bool(
             self.config.get("telemetry.profiler.enabled", default=False)
@@ -1690,6 +1911,18 @@ class Registry:
     async def stop_all(self) -> None:
         # flip readiness first so load balancers stop routing here
         self.health.set_serving(False)
+        # cluster plane next: stop advertising/scraping a node that is
+        # about to lose its serving surfaces
+        if self._federation is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._federation.stop
+            )
+            self._federation = None
+        if self._cluster_heartbeater is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._cluster_heartbeater.stop
+            )
+            self._cluster_heartbeater = None
         if self._replica_pool is not None:
             await asyncio.get_running_loop().run_in_executor(
                 None, self._replica_pool.stop

@@ -10,13 +10,19 @@ GET /metrics of both planes.
 """
 
 from .devstats import DEVSTATS, DeviceStatsCollector
+from .federation import FederationScraper, rollup_health
 from .flight import NOOP_CHECK_TELEMETRY, CheckTelemetry, FlightRecorder
 from .logging import configure_logging, get_logger
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .openmetrics import ParseResult, parse_text
 from .slo import SLOTracker
 from .tracing import Span, Tracer
 
 __all__ = [
+    "FederationScraper",
+    "rollup_health",
+    "ParseResult",
+    "parse_text",
     "configure_logging",
     "get_logger",
     "Counter",

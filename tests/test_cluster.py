@@ -1,0 +1,447 @@
+"""Units for the cluster observability plane: the shared OpenMetrics
+parser, leader-side membership, health rollup thresholds, the federation
+scraper (driven synchronously with canned expositions and a fake clock),
+the follower heartbeater, and the bench trajectory/rotation helpers.
+
+The live 1-leader/2-follower drill — heartbeats over HTTP, federated
+/metrics linting in both formats, the stitched hedged trace — runs in
+tools/replication_gate.py, not here.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from keto_tpu.cluster import ClusterHeartbeater, ClusterMembership
+from keto_tpu.telemetry import (
+    FederationScraper,
+    MetricsRegistry,
+    parse_text,
+    rollup_health,
+)
+
+# -- the shared OpenMetrics parser (linter + federation scraper) ----------
+
+EXPOSITION = """\
+# HELP keto_replication_lag_versions versions behind
+# TYPE keto_replication_lag_versions gauge
+keto_replication_lag_versions 3
+# HELP keto_http_requests_total requests
+# TYPE keto_http_requests_total counter
+keto_http_requests_total{code="200"} 90
+keto_http_requests_total{code="503"} 10
+# HELP keto_slo_events_total events
+# TYPE keto_slo_events_total counter
+keto_slo_events_total 1000
+# HELP keto_slo_bad_events_total bad events
+# TYPE keto_slo_bad_events_total counter
+keto_slo_bad_events_total 20
+# HELP keto_slo_burn_rate burn
+# TYPE keto_slo_burn_rate gauge
+keto_slo_burn_rate{window="fast"} 0.5
+keto_slo_burn_rate{window="slow"} 0.25
+"""
+
+
+class TestParseText:
+    def test_families_and_values(self):
+        parsed = parse_text(EXPOSITION)
+        assert not parsed.errors
+        assert parsed.value("keto_replication_lag_versions") == 3.0
+        assert (
+            parsed.value("keto_slo_burn_rate", {"window": "fast"}) == 0.5
+        )
+        assert parsed.value("keto_slo_burn_rate", {"window": "none"}) is None
+        assert parsed.value("keto_absent") is None
+
+    def test_sum_counter_sums_children(self):
+        parsed = parse_text(EXPOSITION)
+        assert parsed.sum_counter("keto_http_requests_total") == 100.0
+        assert parsed.sum_counter("keto_slo_events_total") == 1000.0
+        assert parsed.sum_counter("keto_absent_total") is None
+
+    def test_samples_named(self):
+        parsed = parse_text(EXPOSITION)
+        rows = parsed.samples_named("keto_http_requests_total")
+        assert {s.labels["code"] for s in rows} == {"200", "503"}
+
+    def test_errors_carry_line_numbers(self):
+        parsed = parse_text("what even is this line\n")
+        assert parsed.errors
+        assert any(e.startswith("line 1:") for e in parsed.errors)
+
+    def test_openmetrics_requires_eof(self):
+        body = "# HELP x y\n# TYPE x gauge\nx 1\n"
+        assert any(
+            "EOF" in e for e in parse_text(body, openmetrics=True).errors
+        )
+        assert not parse_text(body + "# EOF\n", openmetrics=True).errors
+
+
+# -- membership -----------------------------------------------------------
+
+
+class TestMembership:
+    def test_upsert_requires_instance_id(self):
+        m = ClusterMembership()
+        with pytest.raises(ValueError):
+            m.upsert({"role": "follower"})
+
+    def test_heartbeats_accumulate_and_first_seen_sticks(self):
+        t = [100.0]
+        m = ClusterMembership(member_timeout_s=5.0, clock=lambda: t[0])
+        m.upsert({"instance_id": "f0"})
+        t[0] = 101.0
+        row = m.upsert({"instance_id": "f0", "version": 7})
+        assert row["heartbeats"] == 2
+        assert row["first_seen"] == 100.0
+        assert m.get("f0")["version"] == 7
+
+    def test_liveness_ages_out_but_row_survives(self):
+        t = [100.0]
+        m = ClusterMembership(member_timeout_s=5.0, clock=lambda: t[0])
+        m.upsert({"instance_id": "f0"})
+        assert m.members()[0]["alive"]
+        t[0] = 106.0
+        rows = m.members()
+        assert len(rows) == 1 and not rows[0]["alive"]
+        assert rows[0]["age_s"] == 6.0
+        assert m.alive() == []
+
+    def test_members_sorted_by_join_order(self):
+        t = [1.0]
+        m = ClusterMembership(clock=lambda: t[0])
+        for inst in ("c", "a", "b"):
+            m.upsert({"instance_id": inst})
+            t[0] += 1.0
+        assert [r["instance_id"] for r in m.members()] == ["c", "a", "b"]
+
+
+# -- health rollup --------------------------------------------------------
+
+
+class TestRollupHealth:
+    def test_green_when_unknown_fields_are_none(self):
+        level, reasons = rollup_health(
+            {"alive": True, "lag_versions": None, "burn_rate": None}
+        )
+        assert level == "green" and reasons == []
+
+    def test_down_is_red(self):
+        level, reasons = rollup_health({"alive": False, "age_s": 42})
+        assert level == "red"
+        assert any("down" in r for r in reasons)
+
+    def test_threshold_ladder(self):
+        assert rollup_health({"lag_versions": 99})[0] == "green"
+        assert rollup_health({"lag_versions": 100})[0] == "yellow"
+        assert rollup_health({"lag_versions": 10000})[0] == "red"
+        assert rollup_health({"burn_rate": 1.5})[0] == "yellow"
+        assert rollup_health({"burn_rate": 2.0})[0] == "red"
+        assert rollup_health({"staleness_seconds": 60.0})[0] == "red"
+
+    def test_breaker_and_recovery(self):
+        assert rollup_health({"breaker": 1.0})[0] == "red"
+        assert rollup_health({"breaker": 0.5})[0] == "yellow"
+        assert rollup_health({"recovering": True})[0] == "yellow"
+        assert rollup_health({"breaker": 0.0})[0] == "green"
+
+    def test_custom_thresholds(self):
+        view = {"lag_versions": 50}
+        assert rollup_health(view)[0] == "green"
+        assert (
+            rollup_health(view, {"lag_versions_yellow": 10})[0] == "yellow"
+        )
+        # None-valued overrides fall back to the defaults
+        assert (
+            rollup_health(view, {"lag_versions_yellow": None})[0] == "green"
+        )
+
+
+# -- federation scraper ---------------------------------------------------
+
+
+def _scraper(expositions: dict, clock, **kw):
+    """A scraper over a canned {url: exposition_text} fleet."""
+    # NB: "or" would discard an injected-but-empty membership (it has
+    # __len__, so an empty table is falsy)
+    membership = kw.pop("membership", None)
+    if membership is None:
+        membership = ClusterMembership(member_timeout_s=60.0)
+
+    def fetch(url: str, timeout_s: float) -> str:
+        if url not in expositions:
+            raise OSError(f"no route to {url}")
+        return expositions[url]
+
+    metrics = MetricsRegistry()
+    scraper = FederationScraper(
+        membership,
+        metrics,
+        objective=kw.pop("objective", 0.99),
+        fetch_fn=fetch,
+        clock=clock,
+        **kw,
+    )
+    return scraper, membership, metrics
+
+
+class TestFederationScraper:
+    def test_pre_cycle_status_is_unknown(self):
+        scraper, membership, _ = _scraper({}, clock=lambda: 0.0)
+        membership.upsert({"instance_id": "f0"})
+        st = scraper.status()
+        assert st["cluster"]["health"] == "unknown"
+        assert st["cluster"]["members"] == 1
+
+    def test_run_once_federates_and_reexports(self):
+        t = [100.0]
+        scraper, membership, metrics = _scraper(
+            {"http://f0/metrics": EXPOSITION}, clock=lambda: t[0]
+        )
+        membership.upsert(
+            {
+                "instance_id": "f0",
+                "role": "follower",
+                "read_url": "http://f0",
+            }
+        )
+        st = scraper.run_once()
+        (view,) = st["members"]
+        assert view["scrape_ok"] and view["lag_versions"] == 3.0
+        assert view["burn_rate"] == 0.5  # max(fast, slow)
+        assert view["health"] == "green"
+        # re-exported instance-labeled series parse with our own parser
+        parsed = parse_text(metrics.expose())
+        assert (
+            parsed.value(
+                "keto_cluster_replication_lag_versions", {"instance": "f0"}
+            )
+            == 3.0
+        )
+        assert (
+            parsed.value("keto_cluster_member_up", {"instance": "f0"}) == 1.0
+        )
+        assert scraper.status() is st  # cached, no inline scrape
+
+    def test_qps_and_aggregate_burn_from_counter_deltas(self):
+        t = [100.0]
+        expositions = {"http://f0/metrics": EXPOSITION}
+        scraper, membership, metrics = _scraper(
+            expositions, clock=lambda: t[0], objective=0.99
+        )
+        membership.upsert(
+            {
+                "instance_id": "f0",
+                "role": "follower",
+                "read_url": "http://f0",
+            }
+        )
+        st = scraper.run_once()  # first cycle only records prev counters
+        assert st["members"][0]["qps"] is None
+        assert st["cluster"]["aggregate_burn_rate"] == 0.0
+
+        # +200 requests, +200 events (+10 bad) over 10s
+        expositions["http://f0/metrics"] = (
+            EXPOSITION.replace('code="200"} 90', 'code="200"} 280')
+            .replace('code="503"} 10', 'code="503"} 20')
+            .replace("keto_slo_events_total 1000", "keto_slo_events_total 1200")
+            .replace(
+                "keto_slo_bad_events_total 20", "keto_slo_bad_events_total 30"
+            )
+        )
+        t[0] = 110.0
+        st = scraper.run_once()
+        assert st["members"][0]["qps"] == 20.0
+        # (10 bad / 200 events) / (1 - 0.99) budget = 5x burn
+        assert st["cluster"]["aggregate_burn_rate"] == 5.0
+        assert (
+            parse_text(metrics.expose()).value(
+                "keto_cluster_slo_burn_rate_aggregate"
+            )
+            == 5.0
+        )
+
+    def test_leader_lag_defaults_to_zero(self):
+        scraper, membership, _ = _scraper(
+            {"http://l/metrics": "# TYPE x gauge\nx 1\n"},
+            clock=lambda: 0.0,
+        )
+        membership.upsert(
+            {"instance_id": "l0", "role": "leader", "read_url": "http://l"}
+        )
+        (view,) = scraper.run_once()["members"]
+        assert view["lag_versions"] == 0.0
+        assert view["staleness_seconds"] == 0.0
+        assert view["health"] == "green"
+
+    def test_scrape_failure_is_counted_not_fatal(self):
+        scraper, membership, metrics = _scraper({}, clock=lambda: 0.0)
+        membership.upsert(
+            {
+                "instance_id": "f0",
+                "role": "follower",
+                "read_url": "http://gone",
+            }
+        )
+        st = scraper.run_once()
+        (view,) = st["members"]
+        assert not view["scrape_ok"] and "OSError" in view["scrape_error"]
+        assert st["cluster"]["scrape"]["errors"] == 1
+        parsed = parse_text(metrics.expose())
+        assert (
+            parsed.value(
+                "keto_cluster_scrape_errors_total", {"instance": "f0"}
+            )
+            == 1.0
+        )
+
+    def test_self_payload_makes_standalone_a_member(self):
+        scraper, _, _ = _scraper(
+            {},
+            clock=lambda: 0.0,
+            self_payload_fn=lambda: {"instance_id": "me", "role": "leader"},
+        )
+        st = scraper.run_once()
+        assert [m["instance_id"] for m in st["members"]] == ["me"]
+        assert st["cluster"]["alive"] == 1
+
+    def test_member_read_urls_skips_dead_and_selfless(self):
+        t = [100.0]
+        membership = ClusterMembership(
+            member_timeout_s=5.0, clock=lambda: t[0]
+        )
+        scraper, _, _ = _scraper(
+            {}, clock=lambda: t[0], membership=membership
+        )
+        membership.upsert({"instance_id": "f0", "read_url": "http://f0"})
+        membership.upsert({"instance_id": "f1"})  # no read_url
+        t[0] = 102.0
+        membership.upsert({"instance_id": "f2", "read_url": "http://f2"})
+        t[0] = 107.0  # f0/f1 aged out, f2 still fresh
+        assert scraper.member_read_urls() == [("f2", "http://f2")]
+
+    def test_status_json_round_trips(self):
+        scraper, membership, _ = _scraper({}, clock=lambda: 0.0)
+        membership.upsert({"instance_id": "f0"})
+        json.dumps(scraper.run_once())  # must not raise
+
+
+# -- heartbeater ----------------------------------------------------------
+
+
+class TestHeartbeater:
+    def test_beat_once_posts_payload_to_cluster_route(self):
+        posted = []
+        hb = ClusterHeartbeater(
+            "http://leader:4467/",
+            lambda: {"instance_id": "f0", "version": 9},
+            post_fn=lambda url, payload: posted.append((url, payload)),
+        )
+        assert hb.beat_once()
+        assert posted == [
+            (
+                "http://leader:4467/cluster/heartbeat",
+                {"instance_id": "f0", "version": 9},
+            )
+        ]
+        assert hb.beats == 1 and hb.errors == 0
+
+    def test_failures_are_swallowed_and_counted(self):
+        def post(url, payload):
+            raise ConnectionError("leader is restarting")
+
+        hb = ClusterHeartbeater(
+            "http://leader:4467", lambda: {"instance_id": "f0"}, post_fn=post
+        )
+        assert not hb.beat_once()
+        assert hb.beats == 0 and hb.errors == 1
+        assert "leader is restarting" in hb.last_error
+        st = hb.status()
+        assert st["errors"] == 1 and not st["running"]
+
+
+# -- bench satellites: trajectory + heartbeat rotation --------------------
+
+
+class TestBenchTrajectory:
+    def test_no_prior_run_no_deltas(self, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(bench, "_load_prev_headline", lambda: None)
+        assert bench._trajectory({"value": 100}) == (None, [])
+
+    def test_deltas_and_regressions_when_comparable(self, monkeypatch):
+        import bench
+
+        prev = {
+            "metric": "check_rps",
+            "value": 1000.0,
+            "batch_p95_ms": 10.0,
+            "config": "rbac1m",
+            "backend": "cpu",
+        }
+        monkeypatch.setattr(
+            bench, "_load_prev_headline", lambda: ("BENCH_r09.json", prev)
+        )
+        now = {
+            "value": 700.0,  # -30% throughput: regression
+            "batch_p95_ms": 11.0,  # +10% latency: within noise
+            "config": "rbac1m",
+            "backend": "cpu",
+        }
+        vs_prev, regressions = bench._trajectory(now)
+        assert vs_prev["config_match"] is True
+        assert vs_prev["deltas"]["value"]["delta_pct"] == -30.0
+        assert regressions == ["value"]
+
+    def test_incomparable_runs_report_deltas_but_never_flag(
+        self, monkeypatch
+    ):
+        import bench
+
+        prev = {
+            "metric": "check_rps",
+            "value": 1000.0,
+            "config": "rbac100m",
+            "backend": "cpu",
+        }
+        monkeypatch.setattr(
+            bench, "_load_prev_headline", lambda: ("BENCH_r09.json", prev)
+        )
+        vs_prev, regressions = bench._trajectory(
+            {"value": 10.0, "config": "smoke", "backend": "cpu"}
+        )
+        assert vs_prev["config_match"] is False
+        assert "value" in vs_prev["deltas"]
+        assert regressions == []
+
+
+class TestBenchHeartbeatRotation:
+    def test_rotates_at_cap_and_keeps_one_generation(
+        self, tmp_path, monkeypatch
+    ):
+        import bench
+
+        monkeypatch.setenv("BENCH_HEARTBEAT_MAX_BYTES", "64")
+        path = tmp_path / "hb.jsonl"
+        path.write_bytes(b"x" * 100)
+        bench._rotate_heartbeat(str(path))
+        assert not path.exists()
+        assert (tmp_path / "hb.jsonl.1").read_bytes() == b"x" * 100
+
+    def test_under_cap_untouched(self, tmp_path, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("BENCH_HEARTBEAT_MAX_BYTES", "1024")
+        path = tmp_path / "hb.jsonl"
+        path.write_bytes(b"x" * 10)
+        bench._rotate_heartbeat(str(path))
+        assert path.exists() and not (tmp_path / "hb.jsonl.1").exists()
+
+    def test_missing_file_is_fine(self, tmp_path):
+        import bench
+
+        bench._rotate_heartbeat(str(tmp_path / "absent.jsonl"))

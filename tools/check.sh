@@ -21,10 +21,17 @@
 #   4. replication gate — 1 leader + 2 followers in-process: checkpoint
 #      bootstrap + WAL-tail convergence under a lag bound, token-
 #      consistent reads on followers (wait AND bounce paths), read-only
-#      follower write plane, replication metrics exported
-#   5. metrics lint — boot the serving stack, drive traffic, scrape
-#      /metrics from both planes in Prometheus-text and OpenMetrics
-#      formats, and fail on naming/duplicate-series/format violations
+#      follower write plane, replication metrics exported; plus the
+#      cluster-federation drill: follower heartbeats land all 3 members
+#      on the leader's /cluster/status, the leader's federated /metrics
+#      (instance-labeled keto_cluster_* series) lints clean in both
+#      exposition formats, and a hedged check pair stitches into ONE
+#      cross-process trace on the leader's /debug/traces
+#   5. metrics lint — boot the serving stack (cluster federation on, so
+#      the self-federated keto_cluster_* series are linted too), drive
+#      traffic, scrape /metrics from both planes in Prometheus-text and
+#      OpenMetrics formats, and fail on naming/duplicate-series/format
+#      violations
 #   6. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
 #
 # Usage: bash tools/check.sh            (from the repo root)

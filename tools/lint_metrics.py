@@ -4,6 +4,12 @@ gate: boot the full serving stack on loopback ports, drive traffic through
 both transports, scrape /metrics from both planes in both formats, and
 fail on any naming-convention, duplicate-series, or format violation.
 
+The exposition PARSER lives in keto_tpu/telemetry/openmetrics.py
+(``parse_text``) so the cluster federation scraper reads member
+expositions through exactly the grammar this linter enforces; this module
+layers the semantic conventions on top and re-exports ``parse_text`` for
+callers that imported it from here.
+
 The linter is importable (``lint_text(text, openmetrics=False)``) so
 tests can round-trip expositions through it; ``main()`` is the
 tools/check.sh tier.
@@ -25,6 +31,10 @@ Checks enforced per family / series:
 - exemplars (``# {...} value ts``) appear only in OpenMetrics mode and
   only on ``_bucket`` lines; OpenMetrics expositions end with ``# EOF``
 
+The live gate additionally boots the node with cluster self-federation
+enabled, so the leader-side ``keto_cluster_*`` instance-labeled series
+pass the same both-planes / both-formats lint as everything else.
+
 Usage:
     python tools/lint_metrics.py            # live-daemon gate (check.sh)
     python tools/lint_metrics.py --file X   # lint a saved exposition
@@ -35,7 +45,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -43,68 +52,12 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
-_FAMILY_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
-# a sample line: name{labels} value [# {exemplar-labels} value [ts]]
-_SAMPLE_RE = re.compile(
-    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>[^}]*)\})?"
-    r" (?P<value>\S+)"
-    r"(?P<exemplar> # \{[^}]*\} \S+(?: \S+)?)?$"
+from keto_tpu.telemetry.openmetrics import (  # noqa: E402
+    HIST_SUFFIXES,
+    parse_text,
 )
-_ESCAPE_RE = re.compile(r"\\(.)")
-_LEGAL_ESCAPES = {"\\", '"', "n"}
 
-_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
-
-
-def _parse_labels(raw: str):
-    """'a="x",b="y"' -> dict, or a string error."""
-    labels = {}
-    rest = raw
-    while rest:
-        m = re.match(r'([a-zA-Z_][a-zA-Z0-9_]*)="', rest)
-        if m is None:
-            return f"malformed label segment {rest!r}"
-        name = m.group(1)
-        i = m.end()
-        value_chars = []
-        while i < len(rest):
-            c = rest[i]
-            if c == "\\":
-                if i + 1 >= len(rest):
-                    return f"dangling escape in label {name}"
-                esc = rest[i + 1]
-                if esc not in _LEGAL_ESCAPES:
-                    return f"illegal escape \\{esc} in label {name}"
-                value_chars.append(c + esc)
-                i += 2
-                continue
-            if c == '"':
-                break
-            value_chars.append(c)
-            i += 1
-        else:
-            return f"unterminated label value for {name}"
-        if name in labels:
-            return f"duplicate label name {name}"
-        labels[name] = "".join(value_chars)
-        rest = rest[i + 1:]
-        if rest.startswith(","):
-            rest = rest[1:]
-        elif rest:
-            return f"expected ',' between labels, got {rest!r}"
-    return labels
-
-
-def _family_of(sample_name: str, families: dict) -> str | None:
-    """Longest declared family this sample name could belong to."""
-    if sample_name in families:
-        return sample_name
-    for suffix in _HIST_SUFFIXES:
-        if sample_name.endswith(suffix) and sample_name[: -len(suffix)] in families:
-            return sample_name[: -len(suffix)]
-    return None
+__all__ = ["lint_text", "parse_text"]
 
 
 def _le_sort_key(le: str) -> float:
@@ -118,164 +71,62 @@ def _le_sort_key(le: str) -> float:
 
 def lint_text(text: str, openmetrics: bool = False) -> list[str]:
     """Return a list of human-readable violations (empty = clean)."""
-    violations: list[str] = []
-    families: dict[str, dict] = {}  # name -> {help, type, samples}
-    seen_series: set[tuple] = set()
+    parsed = parse_text(text, openmetrics=openmetrics)
+    violations: list[str] = list(parsed.errors)
     # family -> {label-key-without-le: [(le, count)]}
     buckets: dict[str, dict[tuple, list]] = {}
     counts: dict[str, dict[tuple, float]] = {}
-    lines = text.split("\n")
-    if lines and lines[-1] == "":
-        lines.pop()
-    saw_eof = False
-    for lineno, line in enumerate(lines, start=1):
-        if saw_eof:
-            violations.append(f"line {lineno}: content after # EOF")
-            break
-        if line == "# EOF":
-            if not openmetrics:
-                violations.append(
-                    f"line {lineno}: # EOF in a non-OpenMetrics exposition"
-                )
-            saw_eof = True
-            continue
-        if line.startswith("# HELP ") or line.startswith("# TYPE "):
-            kind = line[2:6]
-            rest = line[7:]
-            parts = rest.split(" ", 1)
-            name = parts[0]
-            payload = parts[1] if len(parts) > 1 else ""
-            if not _FAMILY_RE.match(name):
-                violations.append(
-                    f"line {lineno}: family name {name!r} violates "
-                    "lowercase snake_case convention"
-                )
-            fam = families.setdefault(
-                name, {"help": None, "type": None, "samples": 0}
-            )
-            if kind == "HELP":
-                if fam["help"] is not None:
-                    violations.append(
-                        f"line {lineno}: duplicate # HELP for {name}"
-                    )
-                fam["help"] = payload
-            else:
-                if fam["type"] is not None:
-                    violations.append(
-                        f"line {lineno}: duplicate # TYPE for {name}"
-                    )
-                if payload not in ("counter", "gauge", "histogram", "summary"):
-                    violations.append(
-                        f"line {lineno}: unknown TYPE {payload!r} for {name}"
-                    )
-                if fam["samples"]:
-                    violations.append(
-                        f"line {lineno}: # TYPE for {name} after its samples"
-                    )
-                fam["type"] = payload
-            continue
-        if line.startswith("#"):
-            continue  # free-form comment
-        if not line.strip():
-            violations.append(f"line {lineno}: blank line in exposition")
-            continue
-        m = _SAMPLE_RE.match(line)
-        if m is None:
-            violations.append(f"line {lineno}: unparseable sample {line!r}")
-            continue
-        name = m.group("name")
-        raw_labels = m.group("labels")
-        labels = _parse_labels(raw_labels) if raw_labels else {}
-        if isinstance(labels, str):
-            violations.append(f"line {lineno}: {labels}")
-            continue
-        for ln in labels:
-            if not _LABEL_NAME_RE.match(ln):
-                violations.append(
-                    f"line {lineno}: illegal label name {ln!r}"
-                )
-        try:
-            value = float(m.group("value"))
-        except ValueError:
+    for fam in parsed.families.values():
+        ftype = fam.type
+        if ftype == "counter" and not fam.name.endswith("_total"):
             violations.append(
-                f"line {lineno}: non-numeric value {m.group('value')!r}"
+                f"counter family {fam.name} does not end in _total"
             )
-            continue
-        if m.group("exemplar"):
-            if not openmetrics:
-                violations.append(
-                    f"line {lineno}: exemplar in a non-OpenMetrics exposition"
-                )
-            elif not name.endswith("_bucket"):
-                violations.append(
-                    f"line {lineno}: exemplar on non-bucket sample {name}"
-                )
-        fam_name = _family_of(name, families)
-        if fam_name is None:
-            violations.append(
-                f"line {lineno}: sample {name} has no preceding "
-                "# HELP/# TYPE family declaration"
-            )
-            continue
-        fam = families[fam_name]
-        fam["samples"] += 1
-        if fam["help"] is None:
-            violations.append(f"line {lineno}: {fam_name} missing # HELP")
-        if fam["type"] is None:
-            violations.append(f"line {lineno}: {fam_name} missing # TYPE")
-        ftype = fam["type"]
-        if ftype == "counter":
-            if not fam_name.endswith("_total"):
-                violations.append(
-                    f"counter family {fam_name} does not end in _total"
-                )
-            if name != fam_name:
-                violations.append(
-                    f"line {lineno}: counter sample {name} != family "
-                    f"{fam_name}"
-                )
-            if value < 0:
-                violations.append(
-                    f"line {lineno}: negative counter {name} = {value}"
-                )
-        elif ftype == "gauge":
-            if name != fam_name:
-                violations.append(
-                    f"line {lineno}: gauge sample {name} != family {fam_name}"
-                )
-        elif ftype == "histogram":
-            suffix = name[len(fam_name):]
-            if suffix not in _HIST_SUFFIXES:
-                violations.append(
-                    f"line {lineno}: histogram sample suffix {suffix!r} "
-                    f"on {fam_name}"
-                )
-            if suffix == "_bucket":
-                if "le" not in labels:
+        for s in fam.samples:
+            if ftype == "counter":
+                if s.name != fam.name:
                     violations.append(
-                        f"line {lineno}: _bucket sample without le label"
+                        f"line {s.lineno}: counter sample {s.name} != "
+                        f"family {fam.name}"
                     )
-                else:
-                    key = tuple(
-                        sorted(
-                            (k, v) for k, v in labels.items() if k != "le"
+                if s.value < 0:
+                    violations.append(
+                        f"line {s.lineno}: negative counter {s.name} = "
+                        f"{s.value}"
+                    )
+            elif ftype == "gauge":
+                if s.name != fam.name:
+                    violations.append(
+                        f"line {s.lineno}: gauge sample {s.name} != "
+                        f"family {fam.name}"
+                    )
+            elif ftype == "histogram":
+                suffix = s.name[len(fam.name):]
+                if suffix not in HIST_SUFFIXES:
+                    violations.append(
+                        f"line {s.lineno}: histogram sample suffix "
+                        f"{suffix!r} on {fam.name}"
+                    )
+                if suffix == "_bucket":
+                    if "le" not in s.labels:
+                        violations.append(
+                            f"line {s.lineno}: _bucket sample without "
+                            "le label"
                         )
-                    )
-                    buckets.setdefault(fam_name, {}).setdefault(
-                        key, []
-                    ).append((labels["le"], value))
-            elif suffix == "_count":
-                key = tuple(sorted(labels.items()))
-                counts.setdefault(fam_name, {})[key] = value
-        series_key = (name, tuple(sorted(labels.items())))
-        if series_key in seen_series:
-            violations.append(
-                f"line {lineno}: duplicate series {name}"
-                f"{dict(sorted(labels.items()))}"
-            )
-        seen_series.add(series_key)
-    if openmetrics and not saw_eof:
-        violations.append("OpenMetrics exposition missing trailing # EOF")
+                    else:
+                        key = tuple(
+                            sorted(
+                                (k, v)
+                                for k, v in s.labels.items()
+                                if k != "le"
+                            )
+                        )
+                        buckets.setdefault(fam.name, {}).setdefault(
+                            key, []
+                        ).append((s.labels["le"], s.value))
+                elif suffix == "_count":
+                    key = tuple(sorted(s.labels.items()))
+                    counts.setdefault(fam.name, {})[key] = s.value
     # NOTE: a family with # HELP/# TYPE and zero samples is legal — labeled
     # metrics expose headers before their first child is created.
     # bucket monotonicity + +Inf/_count agreement
@@ -327,10 +178,12 @@ def _scrape(port: int, openmetrics: bool) -> str:
 
 
 def _run_live_gate() -> list[str]:
-    """Boot the serving stack, drive both transports, lint every
-    plane/format combination."""
+    """Boot the serving stack (with cluster self-federation on, so the
+    federated keto_cluster_* series are part of the exposition under
+    test), drive both transports, lint every plane/format combination."""
     import asyncio
     import threading
+    import time
     import urllib.request
 
     from keto_tpu.driver.config import Config
@@ -345,6 +198,15 @@ def _run_live_gate() -> list[str]:
             },
             "log": {"level": "error", "format": "json"},
             "tracing": {"provider": ""},
+            # self-federation: this standalone node acts as its own
+            # one-member cluster, so the leader's federated /metrics
+            # (instance-labeled keto_cluster_*) is linted too
+            "cluster": {
+                "enabled": True,
+                "instance_id": "lint-local",
+                "scrape_interval_ms": 200,
+                "heartbeat_interval_ms": 200,
+            },
         },
         env={},
     )
@@ -403,6 +265,19 @@ def _run_live_gate() -> list[str]:
             ),
             timeout=10,
         ).read()
+        # wait for at least one federation scrape cycle to land, so the
+        # keto_cluster_* series exist before the lint pass
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            text = _scrape(read_port, False)
+            if 'keto_cluster_member_up{instance="lint-local"}' in text:
+                break
+            time.sleep(0.2)
+        else:
+            violations.append(
+                "federation: keto_cluster_member_up{instance=\"lint-local\"} "
+                "never appeared on /metrics (self-scrape loop not running?)"
+            )
         for plane, port in (("read", read_port), ("write", write_port)):
             for om in (False, True):
                 label = f"{plane}/{'openmetrics' if om else 'text'}"

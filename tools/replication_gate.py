@@ -15,7 +15,13 @@ What must hold (the tools/check.sh tier):
 - follower write planes reject mutations (read-only follower contract);
 - replication lag/staleness metrics are exported on follower /metrics;
 - the snaptoken-aware multi-endpoint client routes checks across both
-  followers and returns the right answers.
+  followers and returns the right answers;
+- cluster federation: both followers heartbeat to the leader, the
+  leader's /cluster/status lists all 3 members alive, its /metrics
+  carries instance-labeled ``keto_cluster_*`` series that pass the
+  metrics linter in both exposition formats, and a hedged check pair
+  renders as ONE stitched trace on the leader's /debug/traces with
+  spans from at least two distinct processes.
 
 Exit 0 with a one-line summary JSON on stdout; exit 1 with the
 violation list otherwise.
@@ -41,6 +47,17 @@ import httpx  # noqa: E402
 from keto_tpu.driver import Config, Registry  # noqa: E402
 
 LAG_BOUND_S = 10.0  # follower convergence bound for in-process localhost
+CLUSTER_BOUND_S = 20.0  # heartbeat + federation-scrape settle bound
+DEBUG_TOKEN = "replgate-debug"
+
+
+def _cluster(instance_id: str) -> dict:
+    return {
+        "enabled": True,
+        "instance_id": instance_id,
+        "heartbeat_interval_ms": 100,
+        "scrape_interval_ms": 200,
+    }
 
 
 class _Node:
@@ -101,6 +118,8 @@ def main() -> int:
                     "dsn": "memory",
                     "store": {"wal": {"dir": os.path.join(root, "wal")}},
                     "replication": {"role": "leader", "poll_interval_ms": 10},
+                    "cluster": _cluster("leader-0"),
+                    "debug": {"token": DEBUG_TOKEN},
                 }
             )
         )
@@ -141,6 +160,8 @@ def main() -> int:
                                 "dir": os.path.join(root, f"f{i}"),
                                 "poll_interval_ms": 10,
                             },
+                            "cluster": _cluster(f"follower-{i}"),
+                            "debug": {"token": DEBUG_TOKEN},
                         }
                     )
                 )
@@ -250,6 +271,115 @@ def main() -> int:
                     f"router learned nothing from routed reads: {routed}"
                 )
 
+        # -- cluster federation: all 3 members on the leader's status -------
+        deadline = time.monotonic() + CLUSTER_BOUND_S
+        status: dict = {}
+        while True:
+            r = http.get(
+                f"http://127.0.0.1:{leader.read_port}/cluster/status"
+            )
+            status = r.json() if r.status_code == 200 else {}
+            rollup = status.get("cluster") or {}
+            if rollup.get("alive", 0) >= 3 and rollup.get(
+                "health"
+            ) not in (None, "unknown"):
+                break
+            if time.monotonic() > deadline:
+                violations.append(
+                    "cluster did not reach 3 alive federated members "
+                    f"within {CLUSTER_BOUND_S}s: "
+                    f"{json.dumps(status)[:300]}"
+                )
+                break
+            time.sleep(0.1)
+        member_ids = {
+            m.get("instance_id") for m in status.get("members", [])
+        }
+        for want in ("leader-0", "follower-0", "follower-1"):
+            if want not in member_ids:
+                violations.append(
+                    f"/cluster/status lacks member {want}: {member_ids}"
+                )
+
+        # -- federated metrics: instance-labeled gauges, lint-clean --------
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from lint_metrics import lint_text
+
+        for om in (False, True):
+            fmt = "openmetrics" if om else "text"
+            r = http.get(
+                f"http://127.0.0.1:{leader.read_port}/metrics",
+                headers=(
+                    {"Accept": "application/openmetrics-text"} if om else {}
+                ),
+            )
+            problems = lint_text(r.text, openmetrics=om)
+            if problems:
+                violations.append(
+                    f"leader federated /metrics ({fmt}) fails lint: "
+                    f"{problems[:3]}"
+                )
+            for inst in ("follower-0", "follower-1"):
+                want = (
+                    "keto_cluster_replication_lag_versions"
+                    f'{{instance="{inst}"}}'
+                )
+                if want not in r.text:
+                    violations.append(
+                        f"leader /metrics ({fmt}) lacks {want}"
+                    )
+
+        # -- stitched hedged trace: one trace id, spans from 2 processes ---
+        from keto_tpu.client import ReplicatedRestClient as _RC
+        from keto_tpu.client.hedge import HedgePolicy, Hedger
+
+        hedger = Hedger(HedgePolicy(delay_s=0.0))  # always hedge
+        stitched = None
+        try:
+            with _RC(
+                [f"http://127.0.0.1:{f.read_port}" for f in followers],
+                write_url=f"http://127.0.0.1:{leader.write_port}",
+                hedger=hedger,
+            ) as rc:
+                deadline = time.monotonic() + CLUSTER_BOUND_S
+                while stitched is None and time.monotonic() < deadline:
+                    res = rc.check(
+                        "n:fresh-write#view@alice", snaptoken=token_fresh
+                    )
+                    tid = res.traceparent.split("-")[1]
+                    # the losing attempt's span rings slightly later
+                    for _ in range(20):
+                        r = http.get(
+                            f"http://127.0.0.1:{leader.read_port}"
+                            "/debug/traces",
+                            params={"trace_id": tid},
+                            headers={"X-Debug-Token": DEBUG_TOKEN},
+                        )
+                        doc = r.json() if r.status_code == 200 else {}
+                        insts = {
+                            s.get("instance")
+                            for s in doc.get("spans", [])
+                        }
+                        if doc.get("stitched") and len(insts) >= 2:
+                            stitched = doc
+                            break
+                        time.sleep(0.1)
+        finally:
+            hedger.close()
+        if stitched is None:
+            violations.append(
+                "no stitched hedged trace with spans from >=2 instances "
+                f"within {CLUSTER_BOUND_S}s"
+            )
+        else:
+            hedge = stitched.get("hedge") or {}
+            if not hedge.get("winner"):
+                violations.append(
+                    f"stitched trace names no winner: {hedge}"
+                )
+            if not stitched.get("timeline"):
+                violations.append("stitched trace has an empty timeline")
+
         lag_panels = [
             f.registry.replicator().lag() for f in followers
         ]
@@ -264,6 +394,11 @@ def main() -> int:
                 }
                 for p in lag_panels
             ],
+            "cluster_alive": (status.get("cluster") or {}).get("alive"),
+            "cluster_health": (status.get("cluster") or {}).get("health"),
+            "stitched_instances": sorted(
+                (stitched or {}).get("instances") or []
+            ),
             "elapsed_s": round(time.monotonic() - t0, 2),
             "violations": violations,
         }
