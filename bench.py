@@ -56,6 +56,7 @@ import json
 import os
 import resource
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -700,6 +701,22 @@ def _run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_ki
         expander.build_tree(subject, max_depth=3)
         exp_lat.append(time.time() - t0)
 
+    # paged expand: per-PAGE latency on the same roots — the point of the
+    # frontier-bounded walk is a capped p95 regardless of tree width
+    exp_paged_lat = []
+    for key in expand_roots:
+        subject = SubjectSet(namespace=key[0], object=key[1], relation=key[2])
+        token = ""
+        for _page in range(50):  # cap pages per root; p95 wants breadth
+            t0 = time.time()
+            page = expander.build_tree_page(
+                subject, max_depth=3, page_size=256, page_token=token
+            )
+            exp_paged_lat.append(time.time() - t0)
+            token = page.next_page_token
+            if not token:
+                break
+
     meta = {
         "config": name,
         "tuples": len(store),
@@ -717,6 +734,12 @@ def _run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_ki
         "batch_p95_ms": round(1000 * float(np.percentile(lat, 95)), 2),
         "expand_p50_ms": round(1000 * float(np.percentile(exp_lat, 50)), 3),
         "expand_p95_ms": round(1000 * float(np.percentile(exp_lat, 95)), 3),
+        "expand_paged_p50_ms": round(
+            1000 * float(np.percentile(exp_paged_lat, 50)), 3
+        ),
+        "expand_paged_p95_ms": round(
+            1000 * float(np.percentile(exp_paged_lat, 95)), 3
+        ),
         "allowed_frac": round(n_allowed / (batch * iters), 3),
         "rss_gb": _rss_gb(),
     }
@@ -727,6 +750,13 @@ def _run_config(name: str, n_tuples: int, gen, batch: int, iters: int, engine_ki
         meta["closure_mb"] = round(state.m_pad * state.m_pad / 1e6, 1)
         meta["query_mode"] = "host" if engine.host_queries() else "device"
         meta["freshness"] = engine.freshness
+    # where the cold start went: closure-build phase seconds from the
+    # first batch (snapshot_encode / interior / blocks / kernel / total)
+    for phase, secs in (getattr(engine, "last_build_phases", None) or {}).items():
+        meta[f"build_phase_{phase}_s"] = round(float(secs), 4)
+    meta["n_incremental_builds"] = int(
+        getattr(engine, "n_incremental_builds", 0)
+    )
     print(json.dumps(meta), file=sys.stderr, flush=True)
 
     if (
@@ -1507,6 +1537,12 @@ def _smoke_defaults() -> None:
         "BENCH_FEDERATION": "1",
     }.items():
         os.environ.setdefault(k, v)
+    # persistent compile cache on by default in the gate: main() enables
+    # it and the smoke gate asserts it gained entries during the run
+    os.environ.setdefault(
+        "KETO_ENGINE_COMPILE_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "keto-bench-compile-cache"),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -2143,6 +2179,21 @@ def main():
 
     import jax
 
+    # persistent compilation cache (engine.compile_cache_dir in serving;
+    # env-driven here): --smoke defaults it on and asserts it populated
+    cache_dir = os.environ.get("KETO_ENGINE_COMPILE_CACHE_DIR", "")
+    if cache_dir:
+        from keto_tpu.utils.jaxenv import enable_compile_cache
+
+        enabled = enable_compile_cache(cache_dir)
+        print(
+            json.dumps(
+                {"compile_cache_dir": cache_dir, "enabled": enabled}
+            ),
+            file=sys.stderr,
+            flush=True,
+        )
+
     batch = int(os.environ.get("BENCH_BATCH", 4096))
     iters = int(os.environ.get("BENCH_ITERS", 30))
     engine_kind = os.environ.get("BENCH_ENGINE", "closure")
@@ -2318,6 +2369,45 @@ def main():
                             "required": 0.95,
                         }
                     ),
+                    file=sys.stderr,
+                    flush=True,
+                )
+                sys.exit(3)
+        # phase accounting present: the headline must say where the cold
+        # start went (closure build_phase_* seconds from the first batch)
+        for r in results:
+            if r.get("engine") != "closure":
+                continue
+            phases = [k for k in r if k.startswith("build_phase_")]
+            if not phases or "n_incremental_builds" not in r:
+                print(
+                    json.dumps(
+                        {
+                            "gate": "build_phases_missing",
+                            "config": r.get("config"),
+                            "present": phases,
+                        }
+                    ),
+                    file=sys.stderr,
+                    flush=True,
+                )
+                sys.exit(3)
+        # persistent compile cache must actually have persisted something
+        cache_dir = os.environ.get("KETO_ENGINE_COMPILE_CACHE_DIR", "")
+        if cache_dir:
+            n_entries = sum(
+                len(files) for _, _, files in os.walk(cache_dir)
+            )
+            print(
+                json.dumps(
+                    {"compile_cache_dir": cache_dir, "entries": n_entries}
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+            if n_entries == 0:
+                print(
+                    json.dumps({"gate": "compile_cache_empty"}),
                     file=sys.stderr,
                     flush=True,
                 )

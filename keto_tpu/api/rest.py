@@ -531,6 +531,25 @@ class ReadAPI:
             namespace=p["namespace"], object=p["object"], relation=p["relation"]
         )
         depth = max_depth_from_query(p)
+        page_token = p.get("page_token", "")
+        page_size_raw = p.get("page_size")
+        if page_size_raw is not None or page_token:
+            # frontier-bounded paged expand: response shape becomes
+            # {"tree"|"patches", "next_page_token"?} only when the client
+            # opted into paging (page_size and/or page_token present)
+            try:
+                page_size = int(page_size_raw) if page_size_raw else 0
+            except ValueError as e:
+                raise ErrMalformedInput(
+                    f"malformed page_size: {page_size_raw!r}"
+                ) from e
+            page = await asyncio.get_running_loop().run_in_executor(
+                self.executor,
+                lambda: self.expand_engine.build_tree_page(
+                    subject, depth, page_size=page_size, page_token=page_token
+                ),
+            )
+            return web.json_response(page.to_dict())
         tree = await asyncio.get_running_loop().run_in_executor(
             self.executor, self.expand_engine.build_tree, subject, depth
         )

@@ -17,6 +17,7 @@ was computed at.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Callable, Iterator, Optional
@@ -44,6 +45,7 @@ from . import (
     version_pb2,
     write_service_pb2,
 )
+from ..engine.tree import NodeType, Tree
 from .convert import (
     min_version_from,
     query_from_proto_fields,
@@ -323,6 +325,51 @@ class ExpandServicer:
             remaining = context.time_remaining()
             timeout = cap if remaining is None else min(remaining, cap)
             _await_freshness(self.version_waiter, min_version, timeout)
+            # paged expand rides invocation metadata (the checked-in proto
+            # has no paging fields): keto-expand-page-size / -page-token
+            # request it; the continuation token and patch paths come back
+            # as trailing metadata. Page 1 returns the partial tree; later
+            # pages return the patch subtrees as children of a synthetic
+            # union root, path-addressed by keto-expand-patch-paths.
+            md = dict(context.invocation_metadata() or ())
+            page_size_raw = md.get("keto-expand-page-size")
+            page_token = md.get("keto-expand-page-token", "")
+            if page_size_raw is not None or page_token:
+                try:
+                    page_size = int(page_size_raw) if page_size_raw else 0
+                except ValueError as e:
+                    raise ErrMalformedInput(
+                        f"malformed keto-expand-page-size: {page_size_raw!r}"
+                    ) from e
+                page = self.expand_engine.build_tree_page(
+                    subject,
+                    request.max_depth,
+                    page_size=page_size,
+                    page_token=page_token,
+                )
+                trailing = []
+                if page.next_page_token:
+                    trailing.append(
+                        ("keto-expand-next-page-token", page.next_page_token)
+                    )
+                if page.patches:
+                    trailing.append((
+                        "keto-expand-patch-paths",
+                        json.dumps([list(p) for p, _ in page.patches]),
+                    ))
+                    wrapper = Tree(
+                        type=NodeType.UNION,
+                        subject=subject,
+                        children=[t for _, t in page.patches],
+                    )
+                    proto_tree = tree_to_proto(wrapper)
+                else:
+                    proto_tree = tree_to_proto(page.tree)
+                if trailing:
+                    context.set_trailing_metadata(trailing)
+                if proto_tree is None:
+                    return expand_service_pb2.ExpandResponse()
+                return expand_service_pb2.ExpandResponse(tree=proto_tree)
             tree = self.expand_engine.build_tree(subject, request.max_depth)
             proto_tree = tree_to_proto(tree)
             if proto_tree is None:

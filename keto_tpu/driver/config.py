@@ -267,6 +267,21 @@ CONFIG_SCHEMA = {
                     },
                     "additionalProperties": False,
                 },
+                # closure-build math (engine/closure.py): semiring =
+                # masked-SpMV batched BFS with incremental dirty-row
+                # rebuilds; matmul = the legacy dense-cube builder; auto
+                # currently resolves to semiring
+                "closure_builder": {"enum": ["auto", "matmul", "semiring"]},
+                # thread-pool width for block-parallel closure builds
+                # (0 = half the cores, capped at 8)
+                "closure_block_workers": {"type": "integer", "minimum": 0},
+                # default page budget (tree nodes) when an Expand client
+                # requests paging without naming a size (0 = built-in 1024)
+                "expand_page_size": {"type": "integer", "minimum": 0},
+                # JAX persistent compilation cache directory ("" = off):
+                # jitted kernels compiled once survive process restarts,
+                # killing the cold-start recompile on boot/failover
+                "compile_cache_dir": {"type": "string"},
                 # runtime backend failover (driver/registry.py
                 # DeviceSupervisor): on DEVICE_LOST, probe the home
                 # platform in a killable child, hot-swap to CPU while it
@@ -516,6 +531,10 @@ DEFAULTS = {
     "engine.fallback": True,
     "engine.fallback_threshold": 3,
     "engine.fallback_cooldown_ms": 1000,
+    "engine.closure_builder": "auto",
+    "engine.closure_block_workers": 0,
+    "engine.expand_page_size": 0,
+    "engine.compile_cache_dir": "",
     "engine.mesh.data": 1,
     "engine.mesh.edge": 0,
     "engine.memory.admission": True,

@@ -30,6 +30,7 @@ from ..graph.snapshot import SnapshotManager
 from ..store.memory import InMemoryTupleStore
 from ..faults import FAULTS
 from ..utils.errors import ErrMalformedInput
+from ..utils.jaxenv import enable_compile_cache
 from .config import Config
 
 
@@ -361,6 +362,14 @@ class Registry:
         self._profiler = None
         self._config_watcher: Optional[threading.Thread] = None
         self._config_watch_stop = threading.Event()
+        # persistent XLA compilation cache: must point jax at the dir
+        # BEFORE any engine jit-compiles, so it lives in construction
+        self.compile_cache_enabled = enable_compile_cache(
+            str(
+                self.config.get("engine.compile_cache_dir", default="")
+                or ""
+            )
+        )
 
     # -- observability providers (reference registry_default.go:118-136) ------
 
@@ -850,6 +859,16 @@ class Registry:
                         self.config.get("engine.interior_limit")
                     ),
                     query_mode=query_mode,
+                    builder=str(
+                        self.config.get(
+                            "engine.closure_builder", default="auto"
+                        )
+                    ),
+                    block_workers=int(
+                        self.config.get(
+                            "engine.closure_block_workers", default=0
+                        )
+                    ),
                     freshness=str(self.config.get("engine.freshness")),
                     strong_freshness_edges=int(
                         self.config.get("engine.strong_freshness_edges")
@@ -895,13 +914,20 @@ class Registry:
     def expand_engine(self):
         if self._expand_engine is None:
             max_depth = self.config.read_api_max_depth()
+            page_size = int(
+                self.config.get("engine.expand_page_size", default=0)
+            )
             if self.config.engine_mode() == "host":
                 self._expand_engine = ExpandEngine(
-                    self.store(), max_depth=max_depth
+                    self.store(),
+                    max_depth=max_depth,
+                    default_page_size=page_size,
                 )
             else:
                 self._expand_engine = SnapshotExpandEngine(
-                    self.snapshots(), max_depth=max_depth
+                    self.snapshots(),
+                    max_depth=max_depth,
+                    default_page_size=page_size,
                 )
         return self._expand_engine
 

@@ -55,7 +55,12 @@ from typing import Optional, Sequence, Union
 import jax.numpy as jnp
 import numpy as np
 
-from ..graph.interior import InteriorGraph, build_interior, gather_padded_rows
+from ..graph.interior import (
+    InteriorGraph,
+    build_interior,
+    gather_padded_rows,
+    interior_blocks,
+)
 from ..graph.snapshot import GraphSnapshot, SnapshotManager
 from ..ops.closure import (
     INF_DIST,
@@ -78,8 +83,10 @@ _PROBE_SLOW_S = 0.005  # dispatch+transfer slower than this -> host queries
 # deepest resolvable path is 254 interior steps
 _MAX_CLOSURE_DEPTH = INF_DIST
 
-# incremental closure updates are O(M^2) numpy/device work per new interior
-# edge; past this many new edges the O(M^3) full rebuild wins back
+# up to this many appended interior edges the per-edge O(|reach(u)| x
+# |reach(v)|) relax (closure_insert_edge_host) is cheapest; past it the
+# semiring dirty-row rebuild takes over (engine/semiring.py) — bounded by
+# the delta's blast radius, so there is no full-rebuild cliff anymore
 _MAX_INCR_EDGES = 8
 
 # rows whose F0 and L fan-outs both fit this width take the narrow gather
@@ -97,6 +104,12 @@ def _bucket_pow2(n: int, minimum: int = _MIN_BATCH) -> int:
 
 def _bucket_mult(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
+
+
+def _m_pad_for(m: int) -> int:
+    """Padded closure width for a live interior of m nodes: at least one
+    INF row (the PAD index) plus overlay grow headroom, bucketed to 256."""
+    return _bucket_mult(m + 1 + _GROW_RESERVE, 256)
 
 
 def _probe_roundtrip_slow() -> bool:
@@ -146,7 +159,7 @@ class _ClosureArtifacts:
         # target) plus real headroom the write overlay can grow new
         # interior nodes into without forcing a rebuild (engine/overlay.py
         # _grow_interior). ~2% more D memory at the 100M-tuple scale.
-        self.m_pad = _bucket_mult(ig.m + 1 + _GROW_RESERVE, 256)
+        self.m_pad = _m_pad_for(ig.m)
         self.pad = self.m_pad - 1
         if d is None and d_host is None:
             packed = pack_adjacency(ig.ii_src, ig.ii_dst, self.m_pad)
@@ -204,6 +217,8 @@ class ClosureCheckEngine:
         l_max: int = 32,
         query_mode: str = "auto",  # auto | host | device
         freshness: str = "auto",  # auto | strong | bounded
+        builder: str = "auto",  # auto | matmul | semiring
+        block_workers: int = 0,  # semiring build threads (0 = auto)
         strong_freshness_edges: int = 1 << 21,
         rebuild_debounce_s: float = 0.05,
         fallback=None,
@@ -222,8 +237,16 @@ class ClosureCheckEngine:
             raise ValueError(f"unknown query_mode {query_mode!r}")
         if freshness not in ("auto", "strong", "bounded"):
             raise ValueError(f"unknown freshness {freshness!r}")
+        if builder not in ("auto", "matmul", "semiring"):
+            raise ValueError(f"unknown builder {builder!r}")
         self.query_mode = query_mode
         self.freshness = freshness
+        # closure build kernel: "semiring" = masked-SpMV batched BFS
+        # (engine/semiring.py on the host, engine/pallas_spmv.py on
+        # device), "matmul" = the legacy dense MXU build, "auto" =
+        # semiring (work scales with reachable sets, not m_pad^3)
+        self.builder = "semiring" if builder == "auto" else builder
+        self.block_workers = block_workers
         # forked read replicas flip this off: jax is fork-unsafe, so a
         # replica that outgrows its overlay serves from the live-store
         # oracle (slow, exact) instead of attempting a device rebuild
@@ -561,16 +584,136 @@ class ClosureCheckEngine:
                     )
                     phases["total"] = round(time.perf_counter() - t_build, 6)
                     return art
+                if (
+                    self.builder != "matmul"
+                    and host
+                    and prev.d_host is not None
+                    and self._same_interior(prev, snap, ig)
+                ):
+                    # larger delta (or deletions) over an unchanged
+                    # interior node set: semiring dirty-row rebuild —
+                    # work bounded by the delta's blast radius, not M^3
+                    art = self._semiring_incremental(
+                        prev, snap, ig, k_max, phases, span
+                    )
+                    phases["total"] = round(time.perf_counter() - t_build, 6)
+                    return art
             self.n_full_builds += 1
             span.set_attr("kind", "full")
             if self._m_builds is not None:
                 self._m_builds.labels(kind="full").inc()
-            t0 = time.perf_counter()
-            with self.tracer.span("closure.matmul", interior=ig.m):
-                art = _ClosureArtifacts(snap, ig, k_max, host)
-            phases["matmul"] = round(time.perf_counter() - t0, 6)
+            if self.builder == "semiring":
+                t0 = time.perf_counter()
+                with self.tracer.span("closure.blocks", interior=ig.m):
+                    blocks = interior_blocks(ig)
+                phases["blocks"] = round(time.perf_counter() - t0, 6)
+                span.set_attr("blocks", blocks.n_blocks)
+                t0 = time.perf_counter()
+                m_pad = _m_pad_for(ig.m)
+                with self.tracer.span("closure.semiring", interior=ig.m):
+                    if host:
+                        from .semiring import build_closure_bitset
+
+                        d_host = build_closure_bitset(
+                            ig.ii_src,
+                            ig.ii_dst,
+                            ig.m,
+                            m_pad,
+                            k_max,
+                            workers=self._build_workers(),
+                            blocks=blocks,
+                        )
+                        art = _ClosureArtifacts(
+                            snap, ig, k_max, host=True, d_host=d_host
+                        )
+                    else:
+                        from .pallas_spmv import build_closure_semiring
+
+                        packed = pack_adjacency(
+                            ig.ii_src, ig.ii_dst, m_pad
+                        )
+                        d = build_closure_semiring(
+                            jnp.asarray(packed),
+                            jnp.int32(ig.m),
+                            m_pad=m_pad,
+                            k_max=k_max,
+                        )
+                        art = _ClosureArtifacts(
+                            snap, ig, k_max, host=False, d=d
+                        )
+                phases["kernel"] = round(time.perf_counter() - t0, 6)
+            else:
+                t0 = time.perf_counter()
+                with self.tracer.span("closure.matmul", interior=ig.m):
+                    art = _ClosureArtifacts(snap, ig, k_max, host)
+                phases["matmul"] = round(time.perf_counter() - t0, 6)
             phases["total"] = round(time.perf_counter() - t_build, 6)
             return art
+
+    def _build_workers(self) -> int:
+        if self.block_workers > 0:
+            return self.block_workers
+        import os
+
+        return min(8, max(1, (os.cpu_count() or 1) // 2))
+
+    @staticmethod
+    def _same_interior(
+        prev: _ClosureArtifacts, snap: GraphSnapshot, ig: InteriorGraph
+    ) -> bool:
+        """D depends only on the interior-interior adjacency over a stable
+        interior index space: same vocab object (node ids keep their
+        meaning — interning is append-only), same padded width, same
+        interior node set. Any edge delta — inserts, deletes, bulk
+        rewrites — is then incremental-updatable row-wise."""
+        old = prev.snap
+        return (
+            snap.vocab is old.vocab
+            and snap.padded_nodes == old.padded_nodes
+            and np.array_equal(ig.interior_ids, prev.ig.interior_ids)
+        )
+
+    def _semiring_incremental(
+        self,
+        prev: _ClosureArtifacts,
+        snap: GraphSnapshot,
+        ig: InteriorGraph,
+        k_max: int,
+        phases: dict,
+        span,
+    ) -> _ClosureArtifacts:
+        """Dirty-row closure update for an arbitrary interior edge delta
+        (engine/semiring.py): reverse-BFS the blast radius from the
+        changed edges, re-BFS only those rows on the new adjacency."""
+        from .semiring import update_closure_bitset
+
+        t0 = time.perf_counter()
+        blocks = interior_blocks(prev.ig)
+        phases["blocks"] = round(time.perf_counter() - t0, 6)
+        t0 = time.perf_counter()
+        d_host, n_dirty = update_closure_bitset(
+            prev.d_host,
+            prev.ig.ii_src,
+            prev.ig.ii_dst,
+            ig.ii_src,
+            ig.ii_dst,
+            ig.m,
+            prev.m_pad,
+            k_max,
+            workers=self._build_workers(),
+            blocks=blocks,
+        )
+        kernel_s = round(time.perf_counter() - t0, 6)
+        phases["kernel"] = kernel_s
+        phases["incremental"] = kernel_s
+        self.n_incremental_builds += 1
+        span.set_attr("kind", "incremental")
+        span.set_attr("dirty_rows", n_dirty)
+        if self._m_builds is not None:
+            self._m_builds.labels(kind="incremental").inc()
+        return _ClosureArtifacts(
+            snap, ig, k_max, host=True, d_host=d_host
+        )
 
     @staticmethod
     def _appended_interior_edges(

@@ -47,6 +47,12 @@ from ..relationtuple.definitions import (
     SubjectSet,
 )
 from .check import DEFAULT_MAX_DEPTH, clamp_depth
+from .expand import (
+    FALLBACK_PAGE_SIZE,
+    ExpandPage,
+    decode_expand_page_token,
+    encode_expand_page_token,
+)
 from .tree import Tree, NodeType
 
 _MIN_BATCH = 8
@@ -653,6 +659,21 @@ class DeviceCheckEngine:
         return np.asarray(dist)[:n]
 
 
+class _SnapFrame:
+    """One open Union node on the snapshot engine's explicit traversal
+    stack (CSR twin of engine.expand._Frame)."""
+
+    __slots__ = ("subject", "children", "successors", "i", "rest", "path")
+
+    def __init__(self, subject, successors, rest, path):
+        self.subject = subject
+        self.children: list[Tree] = []
+        self.successors = successors  # child node ids, CSR insertion order
+        self.i = 0
+        self.rest = rest
+        self.path = path
+
+
 class SnapshotExpandEngine:
     """Expand-tree construction over the resident CSR (no store round-trips).
 
@@ -664,21 +685,28 @@ class SnapshotExpandEngine:
 
     Traversal is DFS-preorder like the reference — the visited set's
     mutation order is observable in which occurrence of a repeated set gets
-    expanded — but the per-node Python work is collapsed: child node ids
-    come straight from the CSR (no per-node vocab dict probes), the visited
-    set is a bool array, and the bottom level of the tree (where every
-    child renders as a Leaf regardless of its own edges) is built in one
-    bulk pass per node instead of one recursive call per child. At
-    100M-tuple scale a wide depth-3 expand is dominated by exactly that
-    bottom level — millions of Leaf constructions — so the interior
-    recursion stays Python while the fan-out pays only object construction.
+    expanded — but runs on an explicit stack (no recursion limit) with the
+    per-node Python work collapsed: child node ids come straight from the
+    CSR (no per-node vocab dict probes), the visited set is a bool array,
+    and the bottom level of the tree (where every child renders as a Leaf
+    regardless of its own edges) is built in one bulk pass per node instead
+    of one stack frame per child. At 100M-tuple scale a wide depth-3 expand
+    is dominated by exactly that bottom level — millions of Leaf
+    constructions — so the interior walk stays Python while the fan-out
+    pays only object construction. The same stack drives frontier-bounded
+    paged Expand (``build_tree_page``), stitched back by
+    ``engine.tree.apply_expand_patches``.
     """
 
     def __init__(
-        self, snapshots: SnapshotManager, max_depth: int = DEFAULT_MAX_DEPTH
+        self,
+        snapshots: SnapshotManager,
+        max_depth: int = DEFAULT_MAX_DEPTH,
+        default_page_size: int = 0,
     ):
         self.snapshots = snapshots
         self.global_max_depth = max_depth
+        self.default_page_size = default_page_size
 
     def build_tree(
         self, subject: Subject, max_depth: int = 0
@@ -693,47 +721,158 @@ class SnapshotExpandEngine:
             # after this snapshot): no tuples
             return None
         visited = np.zeros(snap.padded_nodes, dtype=bool)
-        return self._expand_set(snap, subject, nid, depth, visited)
+        return self._expand_one(
+            snap, subject, nid, depth, [], visited, [float("inf")], []
+        )
 
-    def _expand_set(
+    def build_tree_page(
         self,
-        snap: GraphSnapshot,
-        subject: SubjectSet,
-        nid: int,
-        rest_depth: int,
-        visited: np.ndarray,
-    ) -> Optional[Tree]:
+        subject: Subject,
+        max_depth: int = 0,
+        page_size: int = 0,
+        page_token: str = "",
+    ) -> ExpandPage:
+        """Frontier-bounded paged Expand over the resident CSR. Same
+        work-queue machinery as the host ExpandEngine; the continuation
+        token carries node ids and pins the snapshot version, so a token
+        outlives its snapshot only as an ErrMalformedPageToken."""
+        depth = clamp_depth(max_depth, self.global_max_depth)
+        snap = self.snapshots.snapshot()
+        if page_size <= 0:
+            page_size = self.default_page_size or FALLBACK_PAGE_SIZE
+        if not isinstance(subject, SubjectSet):
+            return ExpandPage(tree=Tree(type=NodeType.LEAF, subject=subject))
+        visited = np.zeros(snap.padded_nodes, dtype=bool)
+        key_of = snap.vocab._key_of
+        if page_token:
+            pending, vis = decode_expand_page_token(
+                page_token, "snap", snap.version
+            )
+            visited[np.asarray(vis, dtype=np.int64)] = True
+            work = [(path, int(nid), rest) for path, nid, rest in pending]
+            first = False
+        else:
+            nid = snap.vocab.lookup_subject(subject)
+            if nid is None or nid >= snap.padded_nodes:
+                return ExpandPage(tree=None)
+            work = [([], nid, depth)]
+            first = True
+        budget = [page_size]
+        tree: Optional[Tree] = None
+        patches = []
+        while work and budget[0] > 0:
+            path, nid, rest = work.pop(0)
+            k = key_of[nid]
+            subj = SubjectSet(namespace=k[0], object=k[1], relation=k[2])
+            deferred: list = []
+            t = self._expand_one(
+                snap, subj, nid, rest, path, visited, budget, deferred
+            )
+            # deferred descendants resume BEFORE later pending items —
+            # their DFS-preorder position in the unpaged walk
+            work = deferred + work
+            if first:
+                tree = t
+                first = False
+            elif t is not None:
+                patches.append((path, t))
+        token = ""
+        if work:
+            token = encode_expand_page_token(
+                "snap",
+                snap.version,
+                work,
+                np.nonzero(visited)[0].tolist(),
+            )
+        return ExpandPage(tree=tree, patches=patches, next_page_token=token)
+
+    def _enter(self, snap, subject, nid, rest, path, visited, budget):
+        """visited/successors/depth gate of one subject set: a terminal
+        Optional[Tree], an open _SnapFrame, or the bulk bottom level."""
         if visited[nid]:
             return None  # cycle suppression (engine.go:42-45)
         visited[nid] = True
         successors = snap.out_neighbors(nid)
         if successors.size == 0:
             return None  # no tuples (engine.go:67-69)
-        if rest_depth <= 1:
+        budget[0] -= 1
+        if rest <= 1:
             return Tree(type=NodeType.LEAF, subject=subject)
-        if rest_depth == 2:
+        if rest == 2:
+            # whole bottom level in one bulk pass; budget charged for every
+            # materialized Leaf so page overshoot stays one node's fan-out
+            budget[0] -= int(successors.size)
             return self._union_of_leaves(snap, subject, successors, visited)
+        return _SnapFrame(subject, successors.tolist(), rest, path)
+
+    def _expand_one(
+        self, snap, subject, nid, rest, path, visited, budget, deferred
+    ) -> Optional[Tree]:
+        """Iterative DFS-preorder expansion of one work item (explicit
+        stack: subject-set chains outlast Python's recursion limit). Once
+        `budget` is spent, not-yet-entered subject sets render as
+        placeholder Leaves and queue on `deferred` in preorder."""
+        res = self._enter(snap, subject, nid, rest, path, visited, budget)
+        if not isinstance(res, _SnapFrame):
+            return res
         key_of = snap.vocab._key_of
-        children = []
-        for child_nid in successors.tolist():
+        stack = [res]
+        while True:
+            fr = stack[-1]
+            if fr.i >= len(fr.successors):
+                stack.pop()
+                tree = Tree(
+                    type=NodeType.UNION,
+                    subject=fr.subject,
+                    children=fr.children,
+                )
+                if not stack:
+                    return tree
+                stack[-1].children.append(tree)
+                continue
+            idx = fr.i
+            fr.i += 1
+            child_nid = fr.successors[idx]
             k = key_of[child_nid]
             if len(k) == 1:
-                children.append(
+                budget[0] -= 1
+                fr.children.append(
                     Tree(type=NodeType.LEAF, subject=SubjectID(id=k[0]))
                 )
                 continue
             child_subject = SubjectSet(
                 namespace=k[0], object=k[1], relation=k[2]
             )
-            child = self._expand_set(
-                snap, child_subject, child_nid, rest_depth - 1, visited
+            if budget[0] <= 0:
+                # page budget spent: placeholder Leaf now, expansion on a
+                # later page; the resumed _enter re-checks visited, exactly
+                # like the unpaged walk would at this preorder position
+                fr.children.append(
+                    Tree(type=NodeType.LEAF, subject=child_subject)
+                )
+                deferred.append(
+                    (fr.path + [idx], child_nid, fr.rest - 1)
+                )
+                continue
+            res = self._enter(
+                snap,
+                child_subject,
+                child_nid,
+                fr.rest - 1,
+                fr.path + [idx],
+                visited,
+                budget,
             )
-            if child is None:
+            if isinstance(res, _SnapFrame):
+                stack.append(res)
+            else:
                 # nil child (visited cycle / set with no tuples) degrades to a
                 # Leaf for that subject, never dropped (engine.go:80-86)
-                child = Tree(type=NodeType.LEAF, subject=child_subject)
-            children.append(child)
-        return Tree(type=NodeType.UNION, subject=subject, children=children)
+                fr.children.append(
+                    res
+                    if res is not None
+                    else Tree(type=NodeType.LEAF, subject=child_subject)
+                )
 
     @staticmethod
     def _union_of_leaves(

@@ -8,10 +8,29 @@ Mirrors reference internal/expand/engine.go:33-102:
 - A subject set already visited on the current search, or one with no tuples,
   yields no node (``None``) (engine.go:42-45, 67-69).
 - Tuple pages are followed do-while style (engine.go:55-65).
+
+Unlike the reference (and this engine's first cut), the traversal is an
+explicit work stack, not host recursion: a subject-set chain deeper than
+Python's recursion limit — or an adversarial ``max_depth`` — walks fine, and
+the same machinery yields **paged Expand**: ``build_tree_page`` expands
+until ~``page_size`` tree nodes have materialized, returns the partial tree
+(deferred subject sets rendered as placeholder Leaves) plus a continuation
+token, and later pages return path-addressed subtree patches
+(``engine/tree.py apply_expand_patches`` stitches them). Deferred work
+resumes in exact DFS-preorder: once the budget is exhausted no further set
+is entered, so the visited-set mutation order across stitched pages is
+identical to the unpaged walk and the stitched tree is byte-identical.
+
+The continuation token pins the data version it was cut at; a token
+presented after the store moved raises ``ErrMalformedPageToken`` (the
+cursor names nodes that may no longer exist).
 """
 
 from __future__ import annotations
 
+import base64
+import json
+from dataclasses import dataclass, field
 from typing import Optional
 
 from ..relationtuple.definitions import (
@@ -20,29 +39,186 @@ from ..relationtuple.definitions import (
     Subject,
     SubjectSet,
 )
-from ..utils.errors import ErrNotFound
+from ..utils.errors import ErrMalformedPageToken, ErrNotFound
 from ..utils.pagination import PaginationOptions
 from .check import DEFAULT_MAX_DEPTH, clamp_depth
 from .tree import NodeType, Tree
 
+# page budget when a client asks for paging without naming a size and the
+# serving registry configured no default (engine.expand_page_size)
+FALLBACK_PAGE_SIZE = 1024
+
+
+@dataclass
+class ExpandPage:
+    """One page of a paged Expand.
+
+    The first page carries ``tree`` (the partial tree, deferred sets as
+    placeholder Leaves); continuation pages carry ``patches`` — (path,
+    subtree) pairs addressing placeholder Leaves of the stitched-so-far
+    tree. ``next_page_token`` is empty when the expansion is complete.
+    """
+
+    tree: Optional[Tree] = None
+    patches: list = field(default_factory=list)
+    next_page_token: str = ""
+
+    def to_dict(self) -> dict:
+        out: dict = {}
+        if self.patches:
+            out["patches"] = [
+                {"path": list(path), "tree": t.to_dict()}
+                for path, t in self.patches
+            ]
+        else:
+            out["tree"] = None if self.tree is None else self.tree.to_dict()
+        if self.next_page_token:
+            out["next_page_token"] = self.next_page_token
+        return out
+
+
+def encode_expand_page_token(kind: str, version, pending, visited) -> str:
+    """Continuation cursor: base64url(json) of the deferred work items (in
+    DFS-preorder resume order), the visited set, and the data version the
+    page was cut at."""
+    payload = {
+        "k": kind,
+        "v": version,
+        "p": [[list(path), ref, rest] for path, ref, rest in pending],
+        "vis": visited,
+    }
+    raw = json.dumps(payload, separators=(",", ":")).encode()
+    return base64.urlsafe_b64encode(raw).decode()
+
+
+def decode_expand_page_token(token: str, kind: str, version):
+    """-> (pending, visited). Raises ErrMalformedPageToken on garbage, a
+    cursor from the other engine flavor, or a version mismatch (the
+    snapshot the cursor walked has been superseded)."""
+    try:
+        payload = json.loads(base64.urlsafe_b64decode(token.encode()))
+        got_kind = payload["k"]
+        got_version = payload["v"]
+        pending = [
+            (list(path), ref, int(rest))
+            for path, ref, rest in payload["p"]
+        ]
+        visited = payload["vis"]
+    except ErrMalformedPageToken:
+        raise
+    except Exception as e:
+        raise ErrMalformedPageToken(
+            "malformed expand page token"
+        ) from e
+    if got_kind != kind:
+        raise ErrMalformedPageToken(
+            f"expand page token was issued by a {got_kind!r} engine"
+        )
+    if got_version != version:
+        raise ErrMalformedPageToken(
+            f"expand page token expired: issued at version {got_version}, "
+            f"serving {version}"
+        )
+    return pending, visited
+
+
+class _Frame:
+    """One open Union node on the explicit traversal stack."""
+
+    __slots__ = ("subject", "children", "subjects", "i", "rest", "path")
+
+    def __init__(self, subject, subjects, rest, path):
+        self.subject = subject
+        self.children: list[Tree] = []
+        self.subjects = subjects  # child subjects, store insertion order
+        self.i = 0
+        self.rest = rest
+        self.path = path
+
 
 class ExpandEngine:
-    def __init__(self, manager: Manager, max_depth: int = DEFAULT_MAX_DEPTH):
+    def __init__(
+        self,
+        manager: Manager,
+        max_depth: int = DEFAULT_MAX_DEPTH,
+        default_page_size: int = 0,
+    ):
         self.manager = manager
         self.global_max_depth = max_depth
+        self.default_page_size = default_page_size
 
     def build_tree(self, subject: Subject, max_depth: int = 0) -> Optional[Tree]:
         depth = clamp_depth(max_depth, self.global_max_depth)
-        return self._build(subject, depth, visited=set())
-
-    def _build(self, subject: Subject, rest_depth: int, visited: set) -> Optional[Tree]:
         if not isinstance(subject, SubjectSet):
             return Tree(type=NodeType.LEAF, subject=subject)
+        # unbounded budget: nothing defers, the walk completes in one call
+        return self._expand_one(
+            subject, depth, [], set(), [float("inf")], []
+        )
 
-        if str(subject) in visited:
-            return None
-        visited.add(str(subject))
+    def build_tree_page(
+        self,
+        subject: Subject,
+        max_depth: int = 0,
+        page_size: int = 0,
+        page_token: str = "",
+    ) -> ExpandPage:
+        """Frontier-bounded Expand: materialize ~page_size tree nodes (the
+        last entered node may overshoot by its fan-out), defer the rest."""
+        depth = clamp_depth(max_depth, self.global_max_depth)
+        if page_size <= 0:
+            page_size = self.default_page_size or FALLBACK_PAGE_SIZE
+        if not isinstance(subject, SubjectSet):
+            return ExpandPage(tree=Tree(type=NodeType.LEAF, subject=subject))
+        version = getattr(self.manager, "version", 0)
+        if page_token:
+            pending, vis = decode_expand_page_token(
+                page_token, "host", version
+            )
+            visited = set(vis)
+            work = [
+                (path, SubjectSet(ref[0], ref[1], ref[2]), rest)
+                for path, ref, rest in pending
+            ]
+            first = False
+        else:
+            visited = set()
+            work = [([], subject, depth)]
+            first = True
+        budget = [page_size]
+        tree: Optional[Tree] = None
+        patches = []
+        while work and budget[0] > 0:
+            path, subj, rest = work.pop(0)
+            deferred: list = []
+            t = self._expand_one(subj, rest, path, visited, budget, deferred)
+            # deferred descendants must resume BEFORE later pending items:
+            # that is their DFS-preorder position in the unpaged walk
+            work = deferred + work
+            if first:
+                tree = t
+                first = False
+            elif t is not None:
+                patches.append((path, t))
+        token = ""
+        if work:
+            token = encode_expand_page_token(
+                "host",
+                version,
+                [
+                    (path, [s.namespace, s.object, s.relation], rest)
+                    for path, s, rest in work
+                ],
+                sorted(visited),
+            )
+        return ExpandPage(tree=tree, patches=patches, next_page_token=token)
 
+    # -- traversal core --------------------------------------------------------
+
+    def _subjects_of(self, subject: SubjectSet) -> Optional[list[Subject]]:
+        """All tuple subjects of the set, following store pages do-while
+        style (engine.go:55-65); None mirrors the reference's nil returns
+        (unknown namespace / no tuples)."""
         query = RelationQuery(
             namespace=subject.namespace,
             object=subject.object,
@@ -59,18 +235,80 @@ class ExpandEngine:
             rels.extend(page)
             if not token:
                 break
-
         if not rels:
             return None
-        if rest_depth <= 1:
-            return Tree(type=NodeType.LEAF, subject=subject)
+        return [r.subject for r in rels]
 
-        children = []
-        for r in rels:
-            child = self._build(r.subject, rest_depth - 1, visited)
-            if child is None:
-                # nil child (visited cycle / set with no tuples) degrades to a
-                # Leaf for that subject, never dropped (engine.go:80-86)
-                child = Tree(type=NodeType.LEAF, subject=r.subject)
-            children.append(child)
-        return Tree(type=NodeType.UNION, subject=subject, children=children)
+    def _enter(self, subject, rest, path, visited, budget):
+        """The visited/fetch/depth gate of one subject set — the prefix of
+        the reference's recursive call. Returns a terminal Optional[Tree]
+        or an open _Frame for the union node."""
+        key = str(subject)
+        if key in visited:
+            return None
+        visited.add(key)
+        subjects = self._subjects_of(subject)
+        if subjects is None:
+            return None
+        budget[0] -= 1
+        if rest <= 1:
+            return Tree(type=NodeType.LEAF, subject=subject)
+        return _Frame(subject, subjects, rest, path)
+
+    def _expand_one(
+        self, subject, rest, path, visited, budget, deferred
+    ) -> Optional[Tree]:
+        """Expand one work item with an explicit stack. Once `budget` is
+        exhausted, every not-yet-entered subject set renders as a
+        placeholder Leaf and is appended to `deferred` (in DFS-preorder —
+        the resume order)."""
+        res = self._enter(subject, rest, path, visited, budget)
+        if not isinstance(res, _Frame):
+            return res
+        stack = [res]
+        while True:
+            fr = stack[-1]
+            if fr.i >= len(fr.subjects):
+                stack.pop()
+                tree = Tree(
+                    type=NodeType.UNION,
+                    subject=fr.subject,
+                    children=fr.children,
+                )
+                if not stack:
+                    return tree
+                stack[-1].children.append(tree)
+                continue
+            idx = fr.i
+            fr.i += 1
+            child_subject = fr.subjects[idx]
+            if not isinstance(child_subject, SubjectSet):
+                budget[0] -= 1
+                fr.children.append(
+                    Tree(type=NodeType.LEAF, subject=child_subject)
+                )
+                continue
+            child_path = fr.path + [idx]
+            if budget[0] <= 0:
+                # page budget spent: placeholder Leaf now, real expansion
+                # on a later page (unless a later item visits it first —
+                # the resumed _enter re-checks, exactly like the unpaged
+                # walk would have at this point in the preorder)
+                fr.children.append(
+                    Tree(type=NodeType.LEAF, subject=child_subject)
+                )
+                deferred.append((child_path, child_subject, fr.rest - 1))
+                continue
+            res = self._enter(
+                child_subject, fr.rest - 1, child_path, visited, budget
+            )
+            if isinstance(res, _Frame):
+                stack.append(res)
+            else:
+                # nil child (visited cycle / set with no tuples) degrades
+                # to a Leaf for that subject, never dropped (engine.go:80-86)
+                fr.children.append(
+                    res
+                    if res is not None
+                    else Tree(type=NodeType.LEAF, subject=child_subject)
+                )

@@ -323,6 +323,11 @@ class WriteOverlay:
         return self._int_edges_cache
 
     def _note_int_edge_added(self, u: int, v: int) -> None:
+        if u == v:
+            # self-loops are relaxation-neutral (d(u,u) is already 0) AND
+            # the neutralization encoding below stores a deleted edge AS a
+            # self-loop — tracking real ones would collide with ghosts
+            return
         key = _pair_key(u, v)
         pos = self._removed_pos.pop(key, None)
         if pos is not None:
@@ -334,6 +339,12 @@ class WriteOverlay:
         self._int_extras.add(key)
 
     def _note_int_edge_removed(self, u: int, v: int) -> None:
+        if u == v:
+            # a self-loop never lies on a shortest path, so dropping it
+            # cannot lengthen anything; searching for it here would match
+            # the (u,u) ghosts of OTHER neutralized edges of u in one
+            # grouping but not the other and corrupt both
+            return
         type(self)._deletes_seen = True
         key = _pair_key(u, v)
         if key in self._int_extras:
@@ -351,6 +362,8 @@ class WriteOverlay:
         lo = np.searchsorted(dst_d, v)
         hi = np.searchsorted(dst_d, v, side="right")
         hits = np.nonzero(src_d[lo:hi] == u)[0]
+        if hits.size == 0:
+            return  # groupings disagree: not a (whole) base edge
         p_dst = int(lo + hits[0])
         dst_s[p_src] = u  # self-loop: relaxation-neutral
         src_d[p_dst] = v
@@ -438,6 +451,8 @@ class WriteOverlay:
         deletes (a group losing a leaf-ish nested group) affect one or a
         handful of columns — microseconds-to-milliseconds, not the
         multi-minute rebuild."""
+        if u == v:
+            return  # self-loops never carry a shortest path
         art = self.art
         k_max = art.k_max
 

@@ -1,0 +1,315 @@
+"""Boolean-semiring closure builder: masked bitset SpMV instead of matmuls.
+
+The closure matrix D (ops/closure.py) is row-separable: D[i, :] is a
+depth-bounded BFS from interior node i, independent of every other row. The
+dense-matmul builder pays O(m_pad^3) MXU work regardless of sparsity; this
+module recasts the build as a *batched multi-source BFS* under the boolean
+(OR, AND) semiring, GraphBLAS-style (PAPERS.md: "RedisGraph: A
+GraphBLAS-Enabled Graph Database"):
+
+    frontier_{k} = (frontier_{k-1} x A)  AND NOT reached      (masked SpMV)
+
+with bitset rows (1 bit per node, np.packbits layout shared with
+ops.closure.pack_adjacency) so one OR over a 64-bit lane advances 64
+adjacency slots. The reached-mask is the GraphBLAS accumulator mask: only
+*newly* reached nodes contribute adjacency rows to the next step, so total
+work is O(sum of reachable-set sizes x m_pad/8 bytes) — for the sparse
+group/role graphs permission systems actually have, orders of magnitude
+under the dense cube.
+
+Row groups are scheduled by the snapshot's SCC/level blocks
+(graph.interior.interior_blocks) and built concurrently by a small thread
+pool: rows of one block share frontier pages (warm caches) and blocks
+complete in dependency-level order.
+
+Incremental rebuilds (the old `_MAX_INCR_EDGES` cliff): because D is
+row-separable, an interior edge delta invalidates exactly the rows that can
+reach a changed edge's source within k_max-1 hops — every affected path
+must traverse its first changed edge (u, v) after a prefix of unchanged
+edges, so the prefix is visible to a reverse BFS from the changed sources
+over the union adjacency. `update_closure_bitset` recomputes only those
+dirty rows (refined to condensation-ancestor blocks); everything else
+carries over byte-identical. Works for insert AND delete deltas of any
+size, with cost proportional to the blast radius, not the graph.
+
+Parity contract (fuzz-enforced by tests/test_semiring.py): identical uint8
+output to ops.closure.build_closure_packed — distances clamped at k_max,
+INF_DIST=255 elsewhere, diagonal 0 on live rows, padding rows all-INF.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..graph.interior import InteriorBlocks
+from ..ops.closure import INF_DIST, pack_adjacency
+
+# row-group granularity for the batched BFS: the unit of thread-pool work
+# and of the unpackbits staging buffer (group x m_pad bytes, ~4 MB at the
+# 16k interior limit — fits L2/L3, never rivals D itself)
+_ROW_GROUP = 256
+
+
+def _bfs_rows_into(
+    d_out: np.ndarray,
+    adj_packed: np.ndarray,
+    rows: np.ndarray,
+    m_pad: int,
+    k_max: int,
+) -> None:
+    """Masked-SpMV BFS from each of `rows`, writing uint8 distance rows
+    into d_out[rows] (assumed pre-filled with INF). The hot kernel."""
+    n = len(rows)
+    if n == 0:
+        return
+    # distance 1 = the sources' own adjacency rows
+    frontier = adj_packed[rows].copy()  # uint8[n, W] bitset
+    reached = frontier.copy()
+    k = 1
+    while True:
+        fb = np.unpackbits(frontier, axis=1)  # the frontier, one byte/bit
+        rs, vs = np.nonzero(fb)
+        if rs.size == 0:
+            return
+        d_out[rows[rs], vs] = k
+        if k == k_max:
+            return
+        k += 1
+        # masked SpMV step: OR the adjacency rows of newly-reached nodes
+        # into each source's next-frontier bitset; the mask (AND NOT
+        # reached) prunes every node already settled at a smaller k
+        nxt = np.zeros_like(frontier)
+        np.bitwise_or.at(nxt, rs, adj_packed[vs])
+        frontier = nxt & ~reached
+        reached |= frontier
+
+
+def build_closure_bitset(
+    ii_src: np.ndarray,
+    ii_dst: np.ndarray,
+    m: int,
+    m_pad: int,
+    k_max: int,
+    *,
+    workers: int = 0,
+    blocks: Optional[InteriorBlocks] = None,
+    adj_packed: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Full closure build on the host: uint8[m_pad, m_pad], parity-exact
+    with ops.closure.build_closure_packed. `workers` > 1 builds row groups
+    concurrently (numpy releases the GIL for the large bit ops);
+    `blocks` orders the groups block-coherently."""
+    if adj_packed is None:
+        adj_packed = pack_adjacency(ii_src, ii_dst, m_pad)
+    d = np.full((m_pad, m_pad), INF_DIST, dtype=np.uint8)
+    if m > 0:
+        if blocks is not None and blocks.m == m:
+            order = blocks.build_order
+        else:
+            order = np.arange(m, dtype=np.int32)
+        groups = [
+            order[i : i + _ROW_GROUP] for i in range(0, m, _ROW_GROUP)
+        ]
+        if workers > 1 and len(groups) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="closure-blk"
+            ) as pool:
+                list(
+                    pool.map(
+                        lambda g: _bfs_rows_into(
+                            d, adj_packed, g, m_pad, k_max
+                        ),
+                        groups,
+                    )
+                )
+        else:
+            for g in groups:
+                _bfs_rows_into(d, adj_packed, g, m_pad, k_max)
+        # diagonal = 0 on live rows only; padding diag stays INF so the
+        # PAD index is inert in queries (same contract as the matmul path)
+        live = np.arange(m)
+        d[live, live] = 0
+    return d
+
+
+def interior_edge_delta(
+    prev_src: np.ndarray,
+    prev_dst: np.ndarray,
+    new_src: np.ndarray,
+    new_dst: np.ndarray,
+    m_pad: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(inserted int64[ni], deleted int64[nd]) edge keys (u * m_pad + v)
+    between two interior COO edge sets over the SAME interior index space.
+    Duplicates collapse (the adjacency is boolean)."""
+    pk = np.unique(
+        prev_src.astype(np.int64) * m_pad + prev_dst.astype(np.int64)
+    )
+    nk = np.unique(
+        new_src.astype(np.int64) * m_pad + new_dst.astype(np.int64)
+    )
+    inserted = np.setdiff1d(nk, pk, assume_unique=True)
+    deleted = np.setdiff1d(pk, nk, assume_unique=True)
+    return inserted, deleted
+
+
+def _reverse_reach(
+    rev_packed: np.ndarray,
+    seeds: np.ndarray,
+    m_pad: int,
+    steps: int,
+) -> np.ndarray:
+    """bool[m_pad]: nodes that reach any seed within <= steps hops —
+    one multi-source BFS over the reversed bitset adjacency."""
+    w = m_pad // 8
+    reached = np.zeros(w, dtype=np.uint8)
+    seed_bits = np.zeros(m_pad, dtype=np.uint8)
+    seed_bits[seeds] = 1
+    frontier = np.packbits(seed_bits)
+    reached |= frontier
+    for _ in range(steps):
+        fb = np.unpackbits(frontier)
+        vs = np.nonzero(fb)[0]
+        if vs.size == 0:
+            break
+        nxt = np.bitwise_or.reduce(rev_packed[vs], axis=0)
+        frontier = nxt & ~reached
+        reached |= frontier
+    return np.unpackbits(reached).astype(bool)[:m_pad]
+
+
+def dirty_rows(
+    inserted: np.ndarray,
+    deleted: np.ndarray,
+    prev_src: np.ndarray,
+    prev_dst: np.ndarray,
+    new_src: np.ndarray,
+    new_dst: np.ndarray,
+    m: int,
+    m_pad: int,
+    k_max: int,
+    blocks: Optional[InteriorBlocks] = None,
+) -> np.ndarray:
+    """int32 rows whose closure may differ after the edge delta.
+
+    A path affected by the delta crosses its FIRST changed edge (u, v)
+    after a prefix of unchanged edges — edges present in both graphs, hence
+    in the union — of length <= k_max - 1. So reverse-BFS from the changed
+    sources over the union adjacency, k_max - 1 steps, is a sound dirty
+    superset; rows outside it keep byte-identical distance rows. When block
+    metadata is supplied the set is intersected with the condensation
+    ancestors of the changed blocks (a second, structural bound)."""
+    changed_u = np.unique(
+        np.concatenate([inserted, deleted]) // m_pad
+    ).astype(np.int64)
+    if changed_u.size == 0:
+        return np.zeros(0, dtype=np.int32)
+    union_src = np.concatenate([prev_src, new_src])
+    union_dst = np.concatenate([prev_dst, new_dst])
+    rev_packed = pack_adjacency(union_dst, union_src, m_pad)
+    dirty = _reverse_reach(rev_packed, changed_u, m_pad, k_max - 1)
+    dirty[changed_u] = True
+    dirty[m:] = False
+    if blocks is not None and blocks.m == m and blocks.n_blocks:
+        # block refinement: only condensation ancestors of changed blocks
+        # can possibly reach them (the level/SCC structure is computed on
+        # the PREVIOUS adjacency, so only apply it to rows whose dirtiness
+        # comes from deletions/insertions already visible there — the
+        # reverse reach above is the sound bound; the intersection is a
+        # monotone shrink only when the block DAG covers the union graph,
+        # which deletions guarantee and insertions may not. Skip when any
+        # edge was inserted.)
+        if inserted.size == 0:
+            changed_blocks = np.unique(blocks.comp[changed_u])
+            ancestor = _block_ancestors(blocks, changed_blocks, prev_src, prev_dst)
+            dirty[: m] &= ancestor[blocks.comp[np.arange(m)]]
+    return np.nonzero(dirty)[0].astype(np.int32)
+
+
+def _block_ancestors(
+    blocks: InteriorBlocks,
+    changed_blocks: np.ndarray,
+    ii_src: np.ndarray,
+    ii_dst: np.ndarray,
+) -> np.ndarray:
+    """bool[n_blocks]: blocks that can reach any changed block in the
+    condensation DAG (including the changed blocks themselves)."""
+    n = blocks.n_blocks
+    mark = np.zeros(n, dtype=bool)
+    mark[changed_blocks] = True
+    cs = blocks.comp[ii_src]
+    cd = blocks.comp[ii_dst]
+    # propagate reachability backwards; the DAG has <= n_levels frontiers
+    for _ in range(max(blocks.n_levels, 1)):
+        nxt = mark.copy()
+        nxt[cs[mark[cd]]] = True
+        if (nxt == mark).all():
+            break
+        mark = nxt
+    return mark
+
+
+def update_closure_bitset(
+    d_prev: np.ndarray,
+    prev_src: np.ndarray,
+    prev_dst: np.ndarray,
+    new_src: np.ndarray,
+    new_dst: np.ndarray,
+    m: int,
+    m_pad: int,
+    k_max: int,
+    *,
+    workers: int = 0,
+    blocks: Optional[InteriorBlocks] = None,
+) -> tuple[np.ndarray, int]:
+    """Incremental closure update for an arbitrary interior edge delta
+    (inserts and deletes). Returns (d_new, n_dirty_rows); d_prev is not
+    mutated. Exact: dirty rows are recomputed from scratch on the new
+    adjacency, clean rows are carried over."""
+    inserted, deleted = interior_edge_delta(
+        prev_src, prev_dst, new_src, new_dst, m_pad
+    )
+    if inserted.size == 0 and deleted.size == 0:
+        return d_prev, 0
+    rows = dirty_rows(
+        inserted,
+        deleted,
+        prev_src,
+        prev_dst,
+        new_src,
+        new_dst,
+        m,
+        m_pad,
+        k_max,
+        blocks=blocks,
+    )
+    d = d_prev.copy()
+    if rows.size:
+        adj_packed = pack_adjacency(new_src, new_dst, m_pad)
+        d[rows] = INF_DIST
+        if workers > 1 and rows.size > _ROW_GROUP:
+            from concurrent.futures import ThreadPoolExecutor
+
+            groups = [
+                rows[i : i + _ROW_GROUP]
+                for i in range(0, rows.size, _ROW_GROUP)
+            ]
+            with ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="closure-incr"
+            ) as pool:
+                list(
+                    pool.map(
+                        lambda g: _bfs_rows_into(
+                            d, adj_packed, g, m_pad, k_max
+                        ),
+                        groups,
+                    )
+                )
+        else:
+            _bfs_rows_into(d, adj_packed, rows, m_pad, k_max)
+        d[rows, rows] = 0  # dirty rows are live by construction
+    return d, int(rows.size)

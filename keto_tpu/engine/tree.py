@@ -83,3 +83,34 @@ class Tree:
 
 def tree_to_optional_dict(t: Optional[Tree]) -> Optional[dict]:
     return None if t is None else t.to_dict()
+
+
+def apply_expand_patches(tree: Tree, patches) -> Tree:
+    """Stitch paged-Expand continuation pages into the first page's tree.
+
+    Each patch is ``(path, subtree)`` where ``path`` is the child-index
+    path from the root to a placeholder Leaf the paged traversal deferred
+    (engine/expand.py); the placeholder is replaced in place by its
+    expansion. Applying every page's patches in order reproduces the
+    unpaged tree exactly (tests/test_expand_paging.py fuzzes this).
+    """
+    for path, sub in patches:
+        if not path:
+            raise ErrMalformedInput("expand patch with empty path")
+        node = tree
+        for idx in path[:-1]:
+            try:
+                node = node.children[idx]
+            except (IndexError, TypeError) as e:
+                raise ErrMalformedInput(
+                    f"expand patch path {list(path)} does not resolve"
+                ) from e
+        last = path[-1]
+        if not (0 <= last < len(node.children)):
+            raise ErrMalformedInput(
+                f"expand patch path {list(path)} does not resolve"
+            )
+        node.children[last] = (
+            sub if isinstance(sub, Tree) else Tree.from_dict(sub)
+        )
+    return tree

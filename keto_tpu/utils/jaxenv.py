@@ -28,6 +28,27 @@ def cpu_fallback_env() -> dict:
     return env
 
 
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at `cache_dir` (created if
+    missing) so jitted kernels compiled once survive process restarts —
+    the cold-start recompile on boot/failover becomes a disk read. The
+    size/compile-time floors are dropped to zero: this service's kernel
+    set is small and every entry is worth keeping. Returns False (and
+    leaves JAX untouched) when the runtime lacks the cache hooks."""
+    if not cache_dir:
+        return False
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        return True
+    except Exception:
+        return False
+
+
 def virtual_cpu_mesh_env(n_devices: int) -> dict:
     """`cpu_fallback_env` plus an n-device virtual CPU mesh: the
     device-count flag is spliced into any operator-set XLA_FLAGS (append,

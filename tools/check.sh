@@ -32,7 +32,10 @@
 #      traffic, scrape /metrics from both planes in Prometheus-text and
 #      OpenMetrics formats, and fail on naming/duplicate-series/format
 #      violations
-#   6. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
+#   6. closure microbench gate — tools/closure_microbench.py --gate:
+#      incremental closure update after one edge >= 5x faster than a
+#      full semiring rebuild (median-of-5 at m~2048)
+#   7. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
 #
 # Usage: bash tools/check.sh            (from the repo root)
 set -o pipefail
@@ -52,6 +55,12 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/replication_gate.py || exit
 
 echo "== metrics lint =="
 timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/lint_metrics.py || exit 1
+
+echo "== closure microbench gate =="
+# incremental closure update after 1 edge must stay >= 5x faster than a
+# full rebuild (median-of-5, m~2048) — the cold-start/write-path win the
+# semiring engine exists for; regressions exit non-zero here
+timeout -k 10 120 python tools/closure_microbench.py --gate || exit 1
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -1,0 +1,99 @@
+"""Closure-build microbench: full semiring rebuild vs incremental update.
+
+The point of the incremental closure path (keto_tpu.engine.semiring) is that
+a small interior edge delta costs proportional to its blast radius, not the
+graph. This tool measures exactly that claim at a serving-realistic scale
+(m ~ 2048 interior nodes, 3m edges, k_max 4) and — with ``--gate`` — fails
+the build when the incremental update after ONE inserted edge is not at
+least 5x faster than a full rebuild (median of several trials each).
+
+Pure-host numpy path (no jax import): the gate must answer in seconds and
+not depend on which accelerator CI got.
+
+Usage:
+    python tools/closure_microbench.py            # print JSON numbers
+    python tools/closure_microbench.py --gate     # exit 1 on regression
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from keto_tpu.engine.semiring import (  # noqa: E402
+    build_closure_bitset,
+    update_closure_bitset,
+)
+
+M = int(os.environ.get("CLOSURE_BENCH_M", 2048))
+EDGES = int(os.environ.get("CLOSURE_BENCH_EDGES", 3 * M))
+K_MAX = int(os.environ.get("CLOSURE_BENCH_KMAX", 4))
+TRIALS = int(os.environ.get("CLOSURE_BENCH_TRIALS", 5))
+MIN_SPEEDUP = float(os.environ.get("CLOSURE_BENCH_MIN_SPEEDUP", 5.0))
+
+
+def _m_pad(m: int) -> int:
+    return ((m + 255) // 256) * 256
+
+
+def main() -> int:
+    gate = "--gate" in sys.argv
+    rng = np.random.default_rng(11)
+    m_pad = _m_pad(M)
+    src = rng.integers(0, M, EDGES, dtype=np.int32)
+    dst = rng.integers(0, M, EDGES, dtype=np.int32)
+
+    full_s = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        d = build_closure_bitset(src, dst, M, m_pad, K_MAX)
+        full_s.append(time.perf_counter() - t0)
+
+    incr_s = []
+    dirty_counts = []
+    for i in range(TRIALS):
+        # one fresh interior edge per trial — the canonical "a write
+        # landed, re-close" case the old builder answered with a full
+        # O(m^3) rebuild past its 8-edge patch window
+        e_src = np.concatenate([src, [np.int32((17 * i + 3) % M)]])
+        e_dst = np.concatenate([dst, [np.int32((41 * i + 7) % M)]])
+        t0 = time.perf_counter()
+        d_new, n_dirty = update_closure_bitset(
+            d, src, dst, e_src, e_dst, M, m_pad, K_MAX
+        )
+        incr_s.append(time.perf_counter() - t0)
+        dirty_counts.append(n_dirty)
+
+    full_med = float(np.median(full_s))
+    incr_med = float(np.median(incr_s))
+    speedup = full_med / incr_med if incr_med > 0 else float("inf")
+    out = {
+        "m": M,
+        "edges": EDGES,
+        "k_max": K_MAX,
+        "trials": TRIALS,
+        "full_build_median_s": round(full_med, 4),
+        "incremental_median_s": round(incr_med, 4),
+        "dirty_rows_median": int(np.median(dirty_counts)),
+        "speedup": round(speedup, 2),
+        "required_speedup": MIN_SPEEDUP if gate else None,
+    }
+    print(json.dumps(out), flush=True)
+    if gate and speedup < MIN_SPEEDUP:
+        print(
+            f"closure incremental regression: {speedup:.2f}x < "
+            f"{MIN_SPEEDUP}x required",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
