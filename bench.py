@@ -1043,6 +1043,96 @@ def _batch_client_proc(port, payloads, n_threads, seconds, q):
     q.put((np.array([v for lats in lat_all for v in lats]), elapsed))
 
 
+def _encoded_grpc_client_proc(port, frames, n_threads, seconds, q):
+    """Subprocess gRPC BatchCheckEncoded load generator: raw wirecodec
+    frames over the identity-serializer RPC — zero proto objects and
+    zero string materialization on either side of the wire."""
+    import threading
+
+    import grpc
+
+    from keto_tpu.api.services import _PKG
+
+    channels = [
+        grpc.insecure_channel(f"127.0.0.1:{port}") for _ in range(2)
+    ]
+    rpcs = [
+        ch.unary_unary(f"/{_PKG}.CheckService/BatchCheckEncoded")
+        for ch in channels
+    ]
+    rpcs[0](frames[0])
+    lat_all = [[] for _ in range(n_threads)]
+    stop = threading.Event()
+
+    def worker(wid):
+        rpc = rpcs[wid % len(rpcs)]
+        my_lat = lat_all[wid]
+        i = wid
+        while not stop.is_set():
+            f = frames[i % len(frames)]
+            i += 1
+            t0 = time.perf_counter()
+            rpc(f)
+            my_lat.append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(n_threads)
+    ]
+    t_start = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.time() - t_start
+    for ch in channels:
+        ch.close()
+    q.put((np.array([v for lats in lat_all for v in lats]), elapsed))
+
+
+def _encoded_rest_client_proc(port, frames, n_threads, seconds, q):
+    """Subprocess REST /check/batch-encoded load generator (raw frames,
+    application/octet-stream)."""
+    import threading
+
+    import httpx
+
+    lat_all = [[] for _ in range(n_threads)]
+    stop = threading.Event()
+
+    def worker(wid):
+        my_lat = lat_all[wid]
+        with httpx.Client(timeout=60) as client:
+            i = wid
+            while not stop.is_set():
+                body = frames[i % len(frames)]
+                i += 1
+                t0 = time.perf_counter()
+                r = client.post(
+                    f"http://127.0.0.1:{port}/check/batch-encoded",
+                    content=body,
+                    headers={"Content-Type": "application/octet-stream"},
+                )
+                assert r.status_code == 200, r.status_code
+                my_lat.append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(n_threads)
+    ]
+    t_start = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.time() - t_start
+    q.put((np.array([v for lats in lat_all for v in lats]), elapsed))
+
+
 def _columnar_fields(sk, dk) -> dict:
     """The columnar BatchCheck shape (parallel string columns) from sampled
     key pools — shared by the gRPC blob and the REST json body."""
@@ -1099,9 +1189,17 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
     )
     rng = np.random.default_rng(11)
 
+    # wire workers for the id-native tier (shm ring into one batcher):
+    # default 1 — on a host-query CPU pool each replica answers encoded
+    # batches locally, which is the fast path; >1 exercises the ring
+    wire_workers = int(os.environ.get("BENCH_SERVER_WIRE_WORKERS", 1))
     values = {
         "serve": {
-            "read": {"port": 0, "workers": n_workers},
+            "read": {
+                "port": 0,
+                "workers": n_workers,
+                "wire_workers": wire_workers,
+            },
             "write": {"port": 0},
         },
         # per-request logs at info would spam (and single-core: slow)
@@ -1188,9 +1286,11 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
     grpc_batch_blobs = []
     grpc_batch_columnar_blobs = []
     rest_columnar_payloads = []
+    batch_tuples = []  # the RelationTuples behind each blob (encoded leg)
     for _ in range(8):
         sk, dk = sample(rng, batch_size)
         reqs = to_requests(sk, dk)
+        batch_tuples.append(reqs)
         payloads.append(
             json.dumps({"tuples": [t.to_dict() for t in reqs]}).encode()
         )
@@ -1258,6 +1358,85 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
         "columnar REST /check/batch disagrees with the per-tuple transport"
     )
 
+    # id-native wire leg: bootstrap a client VocabCache off /vocab/snapshot,
+    # pre-encode the SAME batches to raw wirecodec frames, and require both
+    # encoded transports to answer exactly like the per-tuple path before
+    # any throughput is measured on them
+    from keto_tpu.api import wirecodec
+    from keto_tpu.api.services import _PKG
+    from keto_tpu.client import VocabCache
+
+    encoded_frames = []
+    encoded_rows = batch_size
+    encoded_parity = "off"
+    try:
+        enc_cols = []
+        with VocabCache(f"http://127.0.0.1:{http_direct}") as cache:
+            cache.bootstrap()
+            for reqs in batch_tuples:
+                s_ids, t_ids, ns_ids = cache.encode(reqs)
+                enc_cols.append((s_ids, t_ids, ns_ids))
+                encoded_frames.append(
+                    wirecodec.encode_check_request(
+                        s_ids,
+                        t_ids,
+                        lineage=cache.lineage,
+                        epoch=cache.epoch,
+                        ns=ns_ids,
+                    )
+                )
+            # drive frames at the tier's natural bulk size: the whole point
+            # of the 8-bytes-per-row wire is that a trusted sidecar ships
+            # thousands of rows per frame (4x the string batch is still a
+            # ~32 KiB payload), amortizing the per-RPC transport cost the
+            # string wire pays per batch_size rows
+            s_all = np.concatenate([c[0] for c in enc_cols])
+            t_all = np.concatenate([c[1] for c in enc_cols])
+            ns_all = np.concatenate([c[2] for c in enc_cols])
+            encoded_rows = min(4 * batch_size, len(s_all))
+            encoded_drive_frames = [
+                wirecodec.encode_check_request(
+                    s_all[i : i + encoded_rows],
+                    t_all[i : i + encoded_rows],
+                    lineage=cache.lineage,
+                    epoch=cache.epoch,
+                    ns=ns_all[i : i + encoded_rows],
+                )
+                for i in range(
+                    0, len(s_all) - encoded_rows + 1, encoded_rows
+                )
+            ]
+        with grpc.insecure_channel(f"127.0.0.1:{grpc_direct}") as ch:
+            rpc = ch.unary_unary(
+                f"/{_PKG}.CheckService/BatchCheckEncoded"
+            )
+            enc_allowed, _tok = wirecodec.decode_check_response(
+                rpc(encoded_frames[0])
+            )
+        assert [bool(v) for v in enc_allowed] == [
+            bool(v) for v in tuple_allowed
+        ], "encoded gRPC BatchCheck disagrees with the per-tuple transport"
+        enc_rest = httpx.post(
+            f"http://127.0.0.1:{http_direct}/check/batch-encoded",
+            content=encoded_frames[0],
+            headers={"Content-Type": "application/octet-stream"},
+            timeout=60,
+        )
+        assert enc_rest.status_code == 200, enc_rest.status_code
+        enc_allowed_rest, _tok = wirecodec.decode_check_response(
+            enc_rest.content
+        )
+        assert [bool(v) for v in enc_allowed_rest] == [
+            bool(v) for v in tuple_allowed
+        ], "encoded REST /check/batch-encoded disagrees with per-tuple"
+        encoded_parity = "ok"
+    except Exception as e:
+        # encoded tier off (serve.read.encoded=false) or unsupported
+        # checker: the string legs still run, the encoded keys go null
+        print(f"[encoded wire leg skipped: {e}]", file=sys.stderr)
+        encoded_frames = []
+        encoded_drive_frames = []
+
     ctx = mp.get_context("spawn")
 
     def drive(target, args_per_proc):
@@ -1324,6 +1503,23 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
             for _ in range(n_procs)
         ],
     )
+    ge_lat = re_lat = None
+    ge_elapsed = re_elapsed = 1.0
+    if encoded_frames:
+        ge_lat, ge_elapsed = drive(
+            _encoded_grpc_client_proc,
+            [
+                (grpc_direct, encoded_drive_frames, 1, seconds)
+                for _ in range(n_procs)
+            ],
+        )
+        re_lat, re_elapsed = drive(
+            _encoded_rest_client_proc,
+            [
+                (http_direct, encoded_drive_frames, 1, seconds)
+                for _ in range(n_procs)
+            ],
+        )
 
     # muxed-port overhead sample: same RPC through the byte-relay port
     mux_lat = []
@@ -1470,6 +1666,37 @@ def run_server_bench(name, store, snapshots, engine, sample, to_requests):
             1000 * float(np.percentile(gbc_lat, 95)), 2
         ),
         "columnar_parity": "ok",  # asserted above: gRPC cols == tuples == REST cols
+        # id-native wire tier: pre-encoded int32 frames, no vocab probes
+        # or proto/string work per tuple (null when the tier is off)
+        "grpc_batch_rps_encoded": (
+            round(len(ge_lat) * encoded_rows / ge_elapsed)
+            if ge_lat is not None
+            else None
+        ),
+        "encoded_rows_per_frame": (
+            encoded_rows if ge_lat is not None else None
+        ),
+        "grpc_batch_encoded_p50_ms": (
+            round(1000 * float(np.percentile(ge_lat, 50)), 2)
+            if ge_lat is not None and len(ge_lat)
+            else None
+        ),
+        "grpc_batch_encoded_p95_ms": (
+            round(1000 * float(np.percentile(ge_lat, 95)), 2)
+            if ge_lat is not None and len(ge_lat)
+            else None
+        ),
+        "rest_batch_rps_encoded": (
+            round(len(re_lat) * encoded_rows / re_elapsed)
+            if re_lat is not None
+            else None
+        ),
+        "rest_batch_encoded_p50_ms": (
+            round(1000 * float(np.percentile(re_lat, 50)), 2)
+            if re_lat is not None and len(re_lat)
+            else None
+        ),
+        "encoded_parity": encoded_parity,
         "mux_grpc_p50_ms": round(1000 * float(np.percentile(mux_lat, 50)), 2),
         # tail phase: deadline-bounded singles under injected device.slow
         # stalls (p999 over BENCH_TAIL_N serial samples ~= the max)
@@ -2373,6 +2600,32 @@ def main():
                     flush=True,
                 )
                 sys.exit(3)
+        # encoded wire gate: when the server leg ran with the id-native
+        # tier on, the encoded transports must have answered identically
+        # to the per-tuple path (parity asserted in-bench) and actually
+        # produced a throughput number — a silently-skipped encoded leg
+        # must fail the smoke, not pass it by omission
+        for r in results:
+            if "encoded_parity" not in r:
+                continue  # server leg skipped — nothing to gate
+            if r.get("encoded_parity") != "ok" or not r.get(
+                "grpc_batch_rps_encoded"
+            ):
+                print(
+                    json.dumps(
+                        {
+                            "gate": "encoded_wire_parity",
+                            "config": r.get("config"),
+                            "encoded_parity": r.get("encoded_parity"),
+                            "grpc_batch_rps_encoded": r.get(
+                                "grpc_batch_rps_encoded"
+                            ),
+                        }
+                    ),
+                    file=sys.stderr,
+                    flush=True,
+                )
+                sys.exit(3)
         # phase accounting present: the headline must say where the cold
         # start went (closure build_phase_* seconds from the first batch)
         for r in results:
@@ -2444,7 +2697,13 @@ def _load_prev_headline() -> tuple[str, dict] | None:
     return None
 
 
-_HIGHER_BETTER = ("value", "grpc_batch_rps", "batch_rps", "device_check_rps")
+_HIGHER_BETTER = (
+    "value",
+    "grpc_batch_rps",
+    "grpc_batch_rps_encoded",
+    "batch_rps",
+    "device_check_rps",
+)
 _LOWER_BETTER = ("batch_p95_ms", "expand_p95_ms", "staleness_p95_ms")
 
 
@@ -2503,6 +2762,10 @@ def _print_primary(results, backend_meta=None):
     enc = primary.get("check_rps_encoded") or 0
     wire = primary.get("grpc_batch_rps") or 0
     serving_overhead = round(enc / wire, 2) if enc and wire else None
+    # wire_overhead: same ratio against the id-native encoded transport —
+    # what the wire still costs once strings/protos/vocab probes are gone
+    enc_wire = primary.get("grpc_batch_rps_encoded") or 0
+    wire_overhead = round(enc / enc_wire, 2) if enc and enc_wire else None
     line = {
         "metric": "check_rps",
         "value": value,
@@ -2523,8 +2786,12 @@ def _print_primary(results, backend_meta=None):
         "grpc_batch_rps": primary.get("grpc_batch_rps"),
         "grpc_batch_tuple_rps": primary.get("grpc_batch_tuple_rps"),
         "grpc_batch_columnar_rps": primary.get("grpc_batch_columnar_rps"),
+        "grpc_batch_rps_encoded": primary.get("grpc_batch_rps_encoded"),
+        "rest_batch_rps_encoded": primary.get("rest_batch_rps_encoded"),
+        "encoded_parity": primary.get("encoded_parity"),
         "grpc_zipf_rps": primary.get("grpc_zipf_rps"),
         "serving_overhead": serving_overhead,
+        "wire_overhead": wire_overhead,
         # the accounting ledger's decomposition of that overhead into
         # named per-stage costs (share of measured check wall time)
         "serving_overhead_breakdown": primary.get(

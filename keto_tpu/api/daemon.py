@@ -184,6 +184,7 @@ def build_read_grpc_server(
     max_freshness_wait_s=30.0,  # float or zero-arg callable (hot reload)
     telemetry=None,  # CheckTelemetry seam (spans/exemplars/SLO/flight)
     version_waiter=None,  # follower replication gate (replication/follower.py)
+    encoded_front=None,  # id-native wire tier (api/encoded.py), or None
 ) -> grpc.Server:
     """Read-plane gRPC: Check + Expand + Read + Version + Health +
     reflection, behind the telemetry interceptor chain (reference
@@ -202,6 +203,7 @@ def build_read_grpc_server(
         CheckServicer(
             checker, snaptoken_fn, max_freshness_wait_s=max_freshness_wait_s,
             telemetry=telemetry, version_waiter=version_waiter,
+            encoded_front=encoded_front,
         ),
     )
     add_expand_service(

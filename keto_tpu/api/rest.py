@@ -46,13 +46,21 @@ from ..relationtuple.definitions import (
     SubjectID,
     SubjectSet,
 )
+from ..graph import vocabsync
 from ..utils.errors import DeadlineExceeded, ErrMalformedInput, KetoError
 from ..utils.pagination import PaginationOptions
+from . import wirecodec
 from .convert import min_version_from
 
 ROUTE_TUPLES = "/relation-tuples"
 ROUTE_CHECK = "/check"
 ROUTE_CHECK_BATCH = "/check/batch"
+# id-native wire tier (keto_tpu extension): pre-encoded int32 batches as
+# raw wirecodec frames, plus the vocab bootstrap/delta feed trusted
+# sidecar clients keep their encode cache fresh with
+ROUTE_CHECK_BATCH_ENCODED = "/check/batch-encoded"
+ROUTE_VOCAB_SNAPSHOT = "/vocab/snapshot"
+ROUTE_VOCAB_DELTAS = "/vocab/deltas"
 ROUTE_EXPAND = "/expand"
 
 #: the REST spelling of a gRPC deadline: milliseconds of budget the caller
@@ -286,8 +294,13 @@ class ReadAPI:
     def __init__(
         self, manager, checker, expand_engine, snaptoken_fn, executor=None,
         telemetry=None, version_waiter=None, max_freshness_wait_s=30.0,
+        encoded_front=None,
     ):
         self.manager = manager
+        # id-native wire tier (api/encoded.EncodedCheckFront); None when
+        # serve.read.encoded is off — the encoded/vocab routes are then
+        # not registered at all
+        self.encoded_front = encoded_front
         self.checker = checker
         self.expand_engine = expand_engine
         self.snaptoken_fn = snaptoken_fn
@@ -311,6 +324,14 @@ class ReadAPI:
         app.router.add_get(ROUTE_CHECK, self.get_check)
         app.router.add_post(ROUTE_CHECK, self.post_check)
         app.router.add_post(ROUTE_CHECK_BATCH, self.post_check_batch)
+        if self.encoded_front is not None:
+            app.router.add_post(
+                ROUTE_CHECK_BATCH_ENCODED, self.post_check_batch_encoded
+            )
+            app.router.add_get(
+                ROUTE_VOCAB_SNAPSHOT, self.get_vocab_snapshot
+            )
+            app.router.add_get(ROUTE_VOCAB_DELTAS, self.get_vocab_deltas)
         app.router.add_get(ROUTE_EXPAND, self.get_expand)
         app.router.add_get("/pipeline", self.get_pipeline)
 
@@ -457,6 +478,96 @@ class ReadAPI:
                 )
                 rec.mark("serialize")
                 return text
+
+        text = await asyncio.get_running_loop().run_in_executor(
+            self.executor, work
+        )
+        return web.Response(text=text, content_type="application/json")
+
+    async def post_check_batch_encoded(
+        self, request: web.Request
+    ) -> web.Response:
+        """keto_tpu extension, id-native wire tier: the body is a raw
+        ``wirecodec`` frame (``application/octet-stream``) of pre-encoded
+        int32 (start, target) columns tagged with the client's vocab
+        lineage/epoch; the response is the codec's bitset frame. An
+        epoch mismatch is a typed 409 with the resync hint in the JSON
+        error envelope."""
+        body = await request.read()
+        req = wirecodec.decode_check_request(body)
+        deadline = deadline_from_headers(request)
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DeadlineExceeded()
+        timeout = (
+            None if deadline is None
+            else max(0.0, deadline - time.monotonic())
+        )
+
+        def work():
+            # the bitset response is packed INSIDE the record so the
+            # ledger's serialize stage covers it (it is ~n/8 bytes —
+            # the whole point of the tier is that this stage vanishes)
+            with self.telemetry.record_check(
+                "rest-encoded", batch_size=len(req.start),
+                deadline=deadline, traceparent=req.traceparent,
+            ) as rec:
+                self._await_freshness(req.min_version, deadline)
+                allowed = self.encoded_front.check(req, timeout=timeout)
+                payload = wirecodec.encode_check_response(
+                    allowed, self.snaptoken_fn()
+                )
+                rec.mark("serialize")
+                return payload
+
+        payload = await asyncio.get_running_loop().run_in_executor(
+            self.executor, work
+        )
+        return web.Response(
+            body=payload, content_type="application/octet-stream"
+        )
+
+    async def get_vocab_snapshot(self, request: web.Request) -> web.Response:
+        """Vocab bootstrap for encoded-wire clients: one page of the
+        append-only key list plus the (lineage, epoch) coordinates the
+        page was read at. Clients page with offset/limit and then follow
+        ``/vocab/deltas`` for keys interned since."""
+        p = request.rel_url.query
+        try:
+            offset = int(p.get("offset", "0"))
+            limit = int(p.get("limit", "200000"))
+        except ValueError:
+            raise ErrMalformedInput(
+                "offset/limit must be integers"
+            ) from None
+
+        def work():
+            vocab = self.encoded_front.vocab()
+            page = vocabsync.snapshot_page(vocab, offset, limit)
+            page["snaptoken"] = self.snaptoken_fn()
+            return json.dumps(page)
+
+        text = await asyncio.get_running_loop().run_in_executor(
+            self.executor, work
+        )
+        return web.Response(text=text, content_type="application/json")
+
+    async def get_vocab_deltas(self, request: web.Request) -> web.Response:
+        """Incremental vocab catch-up: keys interned since ``from`` on
+        lineage ``lineage``. A lineage mismatch (vocab rebuilt, ids
+        reassigned) is the same typed 409 the encoded check path uses —
+        the client re-bootstraps from ``/vocab/snapshot``."""
+        p = request.rel_url.query
+        lineage = p.get("lineage", "")
+        try:
+            from_epoch = int(p.get("from", "0"))
+        except ValueError:
+            raise ErrMalformedInput("from must be an integer") from None
+
+        def work():
+            vocab = self.encoded_front.vocab()
+            page = vocabsync.delta_page(vocab, lineage, from_epoch)
+            page["snaptoken"] = self.snaptoken_fn()
+            return json.dumps(page)
 
         text = await asyncio.get_running_loop().run_in_executor(
             self.executor, work
@@ -692,7 +803,7 @@ def build_read_app(
     cors: Optional[dict] = None, healthy_fn=None, executor=None,
     logger=None, metrics=None, telemetry=None, debug=None,
     version_waiter=None, max_freshness_wait_s=30.0,
-    cluster_status_fn=None,
+    cluster_status_fn=None, encoded_front=None,
 ) -> web.Application:
     # telemetry outermost (sees final codes), then CORS so error
     # responses also carry the headers
@@ -707,6 +818,7 @@ def build_read_app(
         manager, checker, expand_engine, snaptoken_fn, executor,
         telemetry=telemetry, version_waiter=version_waiter,
         max_freshness_wait_s=max_freshness_wait_s,
+        encoded_front=encoded_front,
     ).register(app)
     register_common(app, version, healthy_fn, metrics)
     if cluster_status_fn is not None:

@@ -36,6 +36,7 @@ from ..utils.errors import (
     KetoError,
 )
 from ..utils.pagination import PaginationOptions
+from . import wirecodec
 from . import (
     acl_pb2,
     check_service_pb2,
@@ -93,13 +94,21 @@ def _await_freshness(version_waiter, min_version: int, timeout_s: float):
 def _abort(context: grpc.ServicerContext, err: Exception):
     if isinstance(err, KetoError):
         code = getattr(grpc.StatusCode, err.grpc_code, grpc.StatusCode.INTERNAL)
+        trailing = []
         retry_after = getattr(err, "retry_after_s", None)
         if retry_after is not None:
             # the gRPC spelling of Retry-After: a trailing-metadata hint
             # for shed requests (RESOURCE_EXHAUSTED)
-            context.set_trailing_metadata(
-                (("retry-after", str(int(retry_after))),)
-            )
+            trailing.append(("retry-after", str(int(retry_after))))
+        details = err.envelope().get("error", {}).get("details")
+        if details is not None:
+            # structured error details (e.g. the vocab-epoch resync hint)
+            # ride trailing metadata as JSON — the same payload the REST
+            # envelope carries, so typed clients handle both transports
+            # identically
+            trailing.append(("keto-error-details", json.dumps(details)))
+        if trailing:
+            context.set_trailing_metadata(tuple(trailing))
         context.abort(code, err.message)
     context.abort(grpc.StatusCode.INTERNAL, str(err))
 
@@ -118,10 +127,14 @@ class CheckServicer:
         max_freshness_wait_s=30.0,
         telemetry=None,
         version_waiter=None,
+        encoded_front=None,
     ):
         self.checker = checker
         self.snaptoken_fn = snaptoken_fn
         self._freshness_cap = max_freshness_wait_s
+        # id-native wire tier (api/encoded.EncodedCheckFront); None when
+        # serve.read.encoded is off or the checker has no encoded path
+        self.encoded_front = encoded_front
         # follower-only: wait_for_version(min_version, timeout_s) blocking
         # until replication replays past the token (replication/follower.py)
         self.version_waiter = version_waiter
@@ -284,6 +297,43 @@ class CheckServicer:
                 )
                 resp = check_service_pb2.BatchCheckResponse(
                     allowed=allowed, snaptoken=self.snaptoken_fn()
+                )
+                rec.mark("serialize")
+            return resp
+        except Exception as e:
+            _abort(context, e)
+
+    def BatchCheckEncoded(self, request, context):
+        """keto_tpu extension, id-native wire tier: the request is a raw
+        ``wirecodec`` frame (pre-encoded int32 id columns tagged with the
+        client's vocab lineage/epoch), registered with identity
+        serializers so no protobuf runs on this path. Epoch mismatches
+        abort FAILED_PRECONDITION with the resync hint in trailing
+        metadata (``keto-error-details``)."""
+        try:
+            if self.encoded_front is None:
+                context.abort(
+                    grpc.StatusCode.UNIMPLEMENTED,
+                    "the encoded check tier is disabled "
+                    "(serve.read.encoded)",
+                )
+            req = wirecodec.decode_check_request(request)
+            cap = self._freshness_cap_s()
+            remaining = context.time_remaining()
+            timeout = cap if remaining is None else min(remaining, cap)
+            deadline = (
+                None if remaining is None else time.monotonic() + remaining
+            )
+            _await_freshness(self.version_waiter, req.min_version, timeout)
+            with self.telemetry.record_check(
+                "grpc-encoded",
+                batch_size=len(req.start),
+                deadline=deadline,
+                traceparent=req.traceparent,
+            ) as rec:
+                allowed = self.encoded_front.check(req, timeout=timeout)
+                resp = wirecodec.encode_check_response(
+                    allowed, self.snaptoken_fn()
                 )
                 rec.mark("serialize")
             return resp
@@ -570,6 +620,13 @@ def add_check_service(server, servicer: CheckServicer):
                     check_service_pb2.BatchCheckRequest,
                     check_service_pb2.BatchCheckResponse,
                 ),
+                # identity serializers: the method body is a raw
+                # wirecodec frame, not protobuf — packed int32 columns
+                # go over the wire verbatim and numpy views them on
+                # arrival with zero per-tuple work
+                "BatchCheckEncoded": grpc.unary_unary_rpc_method_handler(
+                    servicer.BatchCheckEncoded
+                ),
             },
         ),
     ))
@@ -678,6 +735,10 @@ class CheckServiceStub:
             response_deserializer=(
                 check_service_pb2.BatchCheckResponse.FromString
             ),
+        )
+        # raw-bytes method (wirecodec frames); no serializers on purpose
+        self.BatchCheckEncoded = channel.unary_unary(
+            f"/{_PKG}.CheckService/BatchCheckEncoded"
         )
 
 

@@ -1,0 +1,189 @@
+"""Client-side versioned vocab cache for the id-native wire tier.
+
+A trusted client (sidecar, gateway, loadgen) that wants the encoded
+``BatchCheckEncoded`` path must encode tuples to node ids with the SAME
+vocab the server serves from. This cache mirrors that vocab over the
+read plane's two sync endpoints:
+
+- ``GET /vocab/snapshot`` — paged bootstrap of the full key list, tagged
+  with the server's ``(lineage, epoch)``;
+- ``GET /vocab/deltas?lineage=..&from=..`` — keys interned since the
+  cache's epoch (the epoch doubles as the delta cursor).
+
+The cache derives the dense namespace-id table from the synced keys with
+the same first-appearance scan the server uses
+(:class:`keto_tpu.graph.vocabsync.NamespaceTable`), so the namespace ids
+it stamps on encoded rows agree with the server's QoS bucketing by
+construction — the table is never shipped.
+
+``encode()`` maps unknown keys to ``-1``; the server clamps any
+out-of-range id to the inert dummy node, so a subject the cache has
+never seen checks to False exactly like the string path. Staleness is
+the server's problem to detect: a write between ``encode()`` and the
+request landing bumps the server epoch and bounces the request with the
+typed mismatch error, whose details carry the resync hint ``sync()``
+follows (delta catch-up within a lineage, full re-bootstrap across a
+vocab rebuild).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..graph.vocabsync import NS_UNKNOWN, NamespaceTable
+from ..relationtuple.definitions import RelationTuple
+from ..graph.vocab import subject_node_key
+from ..utils.errors import ErrVocabEpochMismatch, KetoError
+
+
+class VocabCache:
+    """A synced mirror of the serving vocab: key -> id, plus the derived
+    namespace table. Not thread-safe; give each encoding thread its own
+    cache or serialize access externally."""
+
+    def __init__(
+        self,
+        read_url: str,
+        timeout: float = 30.0,
+        verify=True,
+        transport=None,
+        page_size: int = 200_000,
+        http=None,  # share an existing httpx.Client instead of owning one
+    ):
+        import httpx
+
+        self.read_url = read_url.rstrip("/")
+        self.page_size = int(page_size)
+        self.lineage: str = ""
+        self.epoch: int = 0
+        self._keys: list[tuple] = []
+        self._id_of: dict[tuple, int] = {}
+        self._ns_table = NamespaceTable()
+        self._own_http = http is None
+        self._http = http or httpx.Client(
+            timeout=timeout, verify=verify, transport=transport
+        )
+
+    def close(self) -> None:
+        if self._own_http:
+            self._http.close()
+
+    def __enter__(self) -> "VocabCache":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    # -- sync ------------------------------------------------------------------
+
+    def _get_json(self, path: str, params: dict) -> dict:
+        resp = self._http.get(f"{self.read_url}{path}", params=params)
+        if resp.status_code == 409:
+            try:
+                details = resp.json()["error"]["details"]
+            except (ValueError, KeyError):
+                details = {}
+            raise ErrVocabEpochMismatch(
+                server_lineage=details.get("server_lineage", ""),
+                server_epoch=int(details.get("server_epoch", 0)),
+                client_lineage=self.lineage,
+                client_epoch=self.epoch,
+            )
+        if resp.status_code != 200:
+            raise KetoError(
+                f"vocab sync {path} failed: HTTP {resp.status_code}"
+            )
+        return resp.json()
+
+    def _absorb(self, keys: Sequence[Sequence[str]]) -> None:
+        id_of = self._id_of
+        store = self._keys
+        for k in keys:
+            t = tuple(k)
+            id_of[t] = len(store)
+            store.append(t)
+
+    def bootstrap(self) -> "VocabCache":
+        """Full (re-)bootstrap: page the snapshot until the cache covers
+        the epoch the first page reported, then delta-sync to now (the
+        vocab may have grown while paging)."""
+        self.lineage = ""
+        self.epoch = 0
+        self._keys = []
+        self._id_of = {}
+        self._ns_table = NamespaceTable()
+        offset = 0
+        target_epoch = None
+        while target_epoch is None or offset < target_epoch:
+            page = self._get_json(
+                "/vocab/snapshot",
+                {"offset": offset, "limit": self.page_size},
+            )
+            if target_epoch is None:
+                self.lineage = page["lineage"]
+                target_epoch = int(page["epoch"])
+            elif page["lineage"] != self.lineage:
+                # vocab rebuilt mid-bootstrap: start over on the new lineage
+                return self.bootstrap()
+            keys = page["keys"]
+            self._absorb(keys)
+            offset += len(keys)
+            if not keys and offset < target_epoch:
+                raise KetoError("vocab snapshot paging stalled")
+        self.epoch = offset
+        self._ns_table.extend_from_keys(self._keys)
+        return self.sync()
+
+    def sync(self) -> "VocabCache":
+        """Catch up to the server's current epoch. Delta within the
+        lineage; transparent re-bootstrap when the server's vocab was
+        rebuilt (lineage changed) or the cache has never bootstrapped."""
+        if not self.lineage:
+            return self.bootstrap()
+        try:
+            page = self._get_json(
+                "/vocab/deltas",
+                {"lineage": self.lineage, "from": self.epoch},
+            )
+        except ErrVocabEpochMismatch:
+            return self.bootstrap()
+        self._absorb(page["keys"])
+        self.epoch = int(page["epoch"])
+        self._ns_table.extend_from_keys(self._keys)
+        return self
+
+    # -- encode ----------------------------------------------------------------
+
+    def encode(
+        self, tuples: Sequence[RelationTuple | str]
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(start_ids, target_ids, ns_ids) int32 columns for ``tuples``,
+        encoded against the cache's current epoch. Unknown keys become
+        ``-1`` (server-side: the inert dummy node -> allowed False);
+        namespace ids index the derived table (``-1`` = unknown)."""
+        n = len(tuples)
+        start = np.empty(n, dtype=np.int32)
+        target = np.empty(n, dtype=np.int32)
+        ns = np.empty(n, dtype=np.int32)
+        id_of = self._id_of.get
+        ns_of = self._ns_table.id_of
+        for i, t in enumerate(tuples):
+            if isinstance(t, str):
+                t = RelationTuple.from_string(t)
+            s = id_of((t.namespace, t.object, t.relation))
+            g = id_of(subject_node_key(t.subject))
+            start[i] = -1 if s is None else s
+            target[i] = -1 if g is None else g
+            ns[i] = ns_of(t.namespace)
+        return start, target, ns
+
+    def ns_id(self, namespace: str) -> int:
+        return self._ns_table.id_of(namespace)
+
+
+__all__ = ["VocabCache", "NS_UNKNOWN"]

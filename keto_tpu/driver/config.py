@@ -86,6 +86,13 @@ _PORT_SCHEMA = {
         # read plane only: cap on any snaptoken freshness wait (seconds) —
         # hot-reloadable (HOT_SERVE_KEYS), unlike the rest of serve
         "max_freshness_wait_s": {"type": "number", "minimum": 0},
+        # read plane only: serve the id-native wire tier (encoded
+        # BatchCheck + /vocab bootstrap/delta feed, api/encoded.py)
+        "encoded": {"type": "boolean"},
+        # read plane only: SO_REUSEPORT accept/parse worker processes for
+        # the encoded path, funneling into one device batcher over the
+        # shm ring (engine/shmring.py); rides the fork replica pool
+        "wire_workers": {"type": "integer", "minimum": 1},
     },
     "additionalProperties": True,
 }
@@ -507,6 +514,8 @@ DEFAULTS = {
     "serve.read.workers": 1,
     "serve.read.grpc-max-message-size": 64 << 20,
     "serve.read.max_freshness_wait_s": 30.0,
+    "serve.read.encoded": True,
+    "serve.read.wire_workers": 1,
     "serve.write.port": 4467,
     "serve.write.host": "",
     "serve.write.grpc-max-message-size": 64 << 20,

@@ -350,6 +350,15 @@ class Registry:
         # SO_REUSEPORT replica pool; zeros = normal single-process binds
         self._shared_read_ports: tuple[int, int, int] = (0, 0, 0)
         self._replica_pool = None
+        # id-native wire tier (api/encoded.py + engine/shmring.py): the
+        # encoded front, and — when serve.read.wire_workers > 1 — the
+        # shared-memory ring funneling worker-process batches into this
+        # process's single batcher
+        self._encoded_front = None
+        self._wire_ring = None
+        self._wire_ring_client = None  # set in forked wire workers only
+        self._ring_server = None
+        self._ring_parent_front = None
         self._check_executor = None
         self._logger = None
         self._tracer = None
@@ -1103,6 +1112,54 @@ class Registry:
                 self._checker = self._batcher
         return self._checker
 
+    def encoded_front(self):
+        """The id-native check tier (api/encoded.py): epoch gate + id
+        clamp + QoS bucketing in front of ``check_batch_encoded``. None
+        when serve.read.encoded is off or the checker has no encoded
+        path (the host-oracle _DirectChecker). In a forked wire worker
+        the backend is the shm-ring funnel to the parent's batcher
+        instead of the local one."""
+        if self._encoded_front is None:
+            if not bool(
+                self.config.get("serve.read.encoded", default=True)
+            ):
+                return None
+            checker = self.checker()
+            if self._wire_ring_client is not None:
+                from ..engine.shmring import RingBackend
+
+                backend = RingBackend(self._wire_ring_client)
+            elif hasattr(checker, "check_batch_encoded"):
+                backend = checker
+            else:
+                return None
+            from ..api.encoded import EncodedCheckFront
+
+            self._encoded_front = EncodedCheckFront(
+                self.snapshots(), backend
+            )
+        return self._encoded_front
+
+    def _ring_handler(self, frame: bytes) -> bytes:
+        """Parent-side wire-ring consumer: one encoded frame from a
+        worker process -> the single batcher -> response frame. The
+        worker already ran the strict epoch gate; this side re-clamps
+        ids against ITS snapshot (which may have grown) and debits QoS
+        once, here, where the one set of buckets lives."""
+        from ..api import wirecodec
+        from ..api.encoded import EncodedCheckFront
+
+        front = self._ring_parent_front
+        if front is None:
+            front = self._ring_parent_front = EncodedCheckFront(
+                self.snapshots(), self.checker(), validate=False
+            )
+        req = wirecodec.decode_check_request(frame)
+        allowed = front.check(req, timeout=self._freshness_cap_s())
+        return wirecodec.encode_check_response(
+            allowed, self.read_snaptoken()
+        )
+
     # -- replication (replication/) -------------------------------------------
 
     def replication_role(self) -> str:
@@ -1448,6 +1505,7 @@ class Registry:
                 max_freshness_wait_s=self._freshness_cap_s,
                 telemetry=self.check_telemetry(),
                 version_waiter=self.version_waiter(),
+                encoded_front=self.encoded_front(),
             )
             app = build_read_app(
                 self.store(),
@@ -1469,6 +1527,7 @@ class Registry:
                     if self.federation() is not None
                     else None
                 ),
+                encoded_front=self.encoded_front(),
             )
             self._read_plane = PlaneServer(
                 grpc_server,
@@ -1633,9 +1692,20 @@ class Registry:
 
         gc.freeze()
         n_workers = int(self.config.get("serve.read.workers", default=1))
+        # wire workers (id-native tier): extra SO_REUSEPORT accept/parse
+        # processes that funnel encoded batches into THIS process's one
+        # device batcher over the shm ring (engine/shmring.py). They ride
+        # the fork replica pool — spawn workers cannot share the vocab
+        # lineage minted below, so wire_workers is fork-pool-only.
+        wire_workers = 1
+        if bool(self.config.get("serve.read.encoded", default=True)):
+            wire_workers = int(
+                self.config.get("serve.read.wire_workers", default=1)
+            )
+        n_pool = max(n_workers, wire_workers)
         process_private = getattr(self.store(), "process_private", False)
         if (
-            n_workers > 1
+            n_pool > 1
             and process_private
             and not (
                 hasattr(engine, "host_queries") and engine.host_queries()
@@ -1648,8 +1718,8 @@ class Registry:
                 "mode; serving single-process",
                 engine=type(engine).__name__,
             )
-            n_workers = 1
-        if n_workers > 1:
+            n_pool = n_workers = wire_workers = 1
+        if n_pool > 1:
             from .replicas import ReplicaPool, resolve_free_ports
             from .spawn_workers import SpawnWorkerPool
 
@@ -1672,15 +1742,22 @@ class Registry:
                 # (internal/driver/daemon.go:62-85). Forking here would
                 # double-commit deltas over inherited connections and
                 # inherit threads mid-state.
-                pool = SpawnWorkerPool(self, n_workers)
-                pool.start(
-                    read_port_fixed, grpc_port_fixed, http_port_fixed
-                )
-                log.info(
-                    "read workers spawned",
-                    workers=n_workers,
-                    read_port=read_port_fixed,
-                )
+                if wire_workers > 1:
+                    log.warn(
+                        "serve.read.wire_workers needs the fork replica "
+                        "pool (process-private store); ignoring",
+                        wire_workers=wire_workers,
+                    )
+                if n_workers > 1:
+                    pool = SpawnWorkerPool(self, n_workers)
+                    pool.start(
+                        read_port_fixed, grpc_port_fixed, http_port_fixed
+                    )
+                    log.info(
+                        "read workers spawned",
+                        workers=n_workers,
+                        read_port=read_port_fixed,
+                    )
             else:
                 # fork read replicas BEFORE this process creates any gRPC
                 # server or binds ports (grpc's C core is not fork-safe
@@ -1692,7 +1769,22 @@ class Registry:
                 # inventory still fails, DEMOTE to single-process —
                 # refusing to boot would turn a stray thread into an
                 # outage.
-                fork_pool = ReplicaPool(self, n_workers)
+                # Mint the vocab wire lineage BEFORE forking so every
+                # pool process answers encoded/vocab requests with the
+                # same (lineage, epoch) identity. After a delete-
+                # triggered rebuild the lineages diverge per-process;
+                # clients then bounce with the typed mismatch and
+                # resync — strict equality keeps that correct.
+                from ..graph import vocabsync
+
+                vocabsync.lineage_of(self.snapshots().snapshot().vocab)
+                wire_ring = None
+                if wire_workers > 1:
+                    from ..engine.shmring import WireRing
+
+                    wire_ring = WireRing(n_pool - 1)
+                fork_pool = ReplicaPool(self, n_pool)
+                fork_pool.wire_ring = wire_ring
                 # Wait for TRANSIENT threads (closure rebuild draining,
                 # csr primer finishing) but recognize PERSISTENT ones
                 # fast: if the same offending thread set is seen across a
@@ -1720,12 +1812,27 @@ class Registry:
                         read_port_fixed, grpc_port_fixed, http_port_fixed
                     )
                     pool = fork_pool
+                    if wire_ring is not None:
+                        # parent side of the ring: close the child ends
+                        # (a worker death must read as EOF) and start
+                        # the consumer threads feeding the one batcher
+                        from ..engine.shmring import RingServer
+
+                        wire_ring.parent_seal()
+                        self._wire_ring = wire_ring
+                        self._ring_server = RingServer(
+                            wire_ring, self._ring_handler, logger=log
+                        )
+                        self._ring_server.start()
                     log.info(
                         "read replicas forked",
-                        workers=n_workers,
+                        workers=n_pool,
+                        wire_workers=wire_workers,
                         read_port=read_port_fixed,
                     )
                 except RuntimeError as e:
+                    if wire_ring is not None:
+                        wire_ring.close()
                     log.warn(
                         "cannot fork read replicas; serving "
                         "single-process",
@@ -1954,6 +2061,17 @@ class Registry:
                 None, self._replica_pool.stop
             )
             self._replica_pool = None
+        # wire ring after the pool: the workers holding the child ends
+        # are gone, so stopping the server threads cannot strand an
+        # in-flight frame
+        if self._ring_server is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._ring_server.stop
+            )
+            self._ring_server = None
+        if self._wire_ring is not None:
+            self._wire_ring.close()
+            self._wire_ring = None
         if self._config_watcher is not None:
             self._config_watch_stop.set()
             self._config_watcher.join(timeout=5)

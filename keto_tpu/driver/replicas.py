@@ -210,6 +210,12 @@ class ReplicaPool:
         self._wake_w: Optional[socket.socket] = None
         self._m_respawns = None
         self._m_resyncs = None
+        # shm ring for the id-native wire tier (engine/shmring.py), set
+        # by the registry before fork_replicas when wire workers are on:
+        # child i claims endpoint i-1 at fork; the zygote (and therefore
+        # every respawned replica) drops all ends and serves encoded
+        # checks in-process instead
+        self.wire_ring = None
 
     # -- parent side -----------------------------------------------------------
 
@@ -298,6 +304,9 @@ class ReplicaPool:
             raise
         if pid == 0:
             parent_sock.close()
+            if self.wire_ring is not None:
+                self.wire_ring.drop_inherited()
+                self.wire_ring = None
             try:
                 self._zygote_main(child_sock)
             finally:
@@ -330,6 +339,12 @@ class ReplicaPool:
                 raise
             if pid == 0:
                 parent_sock.close()
+                if self.wire_ring is not None:
+                    # endpoint i-1 belongs to child i (endpoints are
+                    # numbered over the children; the parent has none)
+                    self.registry._wire_ring_client = (
+                        self.wire_ring.child_claim(i - 1)
+                    )
                 try:
                     self._child_main(
                         i, child_sock, read_port, grpc_port, http_port

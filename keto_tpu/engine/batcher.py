@@ -652,16 +652,25 @@ class CheckBatcher:
         depths=None,
         min_version: int = 0,
         timeout: Optional[float] = None,
+        ns_counts: Optional[dict] = None,
     ) -> list[bool]:
-        """Pre-encoded id batches (array-native clients, bench): probe the
-        encoded cache on (start, target, depth) triples and dispatch only
-        the misses through the engine's array path — zero per-item Python
-        objects end to end."""
+        """Pre-encoded id batches (array-native clients, the id-native
+        wire tier, bench): probe the encoded cache on (start, target,
+        depth) triples and dispatch only the misses through the engine's
+        array path — zero per-item Python objects end to end.
+
+        ``ns_counts`` is the per-namespace row count the wire front
+        derived from the request's namespace-id column (id -> name via
+        the vocab-synced NamespaceTable, so only unique tenant names are
+        materialized, never per-row strings); when present it is charged
+        against the same QoS buckets the string paths use."""
         if self._closed:
             raise BatcherClosed()
         n = len(start_ids)
         if n == 0:
             return []
+        if ns_counts and self.qos is not None:
+            self.qos.admit_counts(ns_counts)
         if min_version > 0:
             wait = getattr(self.engine, "wait_for_version", None)
             if wait is not None:

@@ -804,6 +804,11 @@ class WriteOverlay:
             dep = int(depth[i])
             if dep < 1:
                 continue
+            if s < 0 or t < 0:
+                # unknown endpoint (raw -1 from a vocab miss): no overlay
+                # edge can touch it — and letting it through would wrap
+                # the numpy gathers below onto the LAST node's rows
+                continue
             # direct edge: base XOR delta
             delta = self.direct_delta.get(_pair_key(s, t), 0)
             if delta > 0:

@@ -13,9 +13,13 @@ retryable 429/RESOURCE_EXHAUSTED contract the global shed uses — plus a
 
 Admission happens at the batcher's entry points before any queueing or
 engine work, one debit per check row (a batch debits its per-namespace
-row counts). The encoded fast path carries no namespace strings by
-design and bypasses QoS — it is an internal/bench surface, not a tenant
-one.
+row counts). The id-native wire tier carries no per-row namespace
+*strings*, but it is a tenant surface: encoded requests ship a
+namespace-id column, the wire front maps the unique ids back to names
+through the vocab-synced ``NamespaceTable`` (O(tenants), not O(rows)),
+and the resulting per-namespace counts are debited from these same
+buckets — so ``keto_qos_throttled_total{namespace}`` covers encoded
+traffic without materializing per-row strings.
 """
 
 from __future__ import annotations
@@ -79,6 +83,7 @@ class NamespaceQos:
         self._clock = clock
         self._lock = threading.Lock()
         self._buckets: dict[str, _Bucket] = {}
+        self._throttled_counts: dict[str, int] = {}
         self._throttled = None
         if metrics is not None:
             self._throttled = metrics.counter(
@@ -108,6 +113,9 @@ class NamespaceQos:
                 b.tokens -= n
                 return
             deficit = n - b.tokens
+            self._throttled_counts[namespace] = (
+                self._throttled_counts.get(namespace, 0) + 1
+            )
         if self._throttled is not None:
             self._throttled.labels(namespace=namespace).inc()
         raise QosThrottled(namespace, retry_after_s=deficit / rate)
@@ -133,4 +141,5 @@ class NamespaceQos:
                     ns: round(b.tokens, 2)
                     for ns, b in self._buckets.items()
                 },
+                "throttled": dict(self._throttled_counts),
             }

@@ -336,10 +336,19 @@ class SnapshotManager:
                 min_edges=self.min_edges,
             ).build_from_ids(src, dst, version)
         tuples, version = self._store.snapshot()
-        # Fresh vocab on rebuild: deletes may have orphaned nodes, and a fresh
-        # intern keeps ids dense. Stable-id incremental path never comes here.
+        # Persistent vocab across rebuilds: node ids are append-only for the
+        # life of the manager, matching the columnar path above. The id-native
+        # wire tier hands clients (lineage, epoch)-tagged ids, and the closure
+        # engine's artifacts + write overlay intern into the same object — a
+        # fresh vocab here would re-number nodes and silently split those
+        # universes apart. Deletes orphan their ids instead of re-densifying
+        # (re-densify would invalidate every client cache and any in-flight
+        # artifact mid-rebuild).
+        prev = getattr(self, "_snap", None)
         return SnapshotBuilder(
-            min_nodes=self.min_nodes, min_edges=self.min_edges
+            vocab=prev.vocab if prev is not None else None,
+            min_nodes=self.min_nodes,
+            min_edges=self.min_edges,
         ).build(tuples, version)
 
     # -- write side (delta feed) ---------------------------------------------
@@ -356,6 +365,12 @@ class SnapshotManager:
                 # bulk change of unknown shape (columnar bulk load):
                 # rebuild on next read
                 self._dirty = True
+                return
+            if not self._dirty and version <= snap.version:
+                # a snapshot() rebuild raced ahead of this callback and
+                # already read the store at (or past) this version — the
+                # delta is absorbed; re-marking dirty would force a
+                # gratuitous rebuild per write
                 return
             if self._dirty or version != snap.version + 1 or deleted:
                 self._dirty = True

@@ -1,0 +1,818 @@
+"""Id-native wire tier suite: the encoded BatchCheck path end to end.
+
+- wirecodec frame round-trips (request columns, response bitset)
+- vocab sync protocol: snapshot paging, delta catch-up, lineage bounce
+- client VocabCache: bootstrap/encode parity with the server vocab,
+  unknown keys -> -1 -> allowed False
+- epoch-mismatch resync drill: a write lands between encode() and the
+  request; the server bounces 409/FAILED_PRECONDITION with the typed
+  resync hint; sync() + retry succeeds — on BOTH transports
+- encoded-vs-columnar parity fuzz through the live REST and gRPC
+  transports (same answers as the per-tuple string path)
+- per-tenant QoS on the encoded path: the namespace-id column is
+  bucketed without string materialization; a drained tenant 429s
+- shm ring fault drills: parent death fails pending futures with the
+  typed RingError (no lost futures), a dead worker retires only its
+  lane, slot exhaustion is a retryable 429, remote errors revive typed
+"""
+
+import asyncio
+import pickle
+import threading
+import time
+
+import grpc
+import httpx
+import numpy as np
+import pytest
+
+from keto_tpu.api import wirecodec
+from keto_tpu.api.encoded import EncodedCheckFront
+from keto_tpu.api.services import _PKG
+from keto_tpu.client import GrpcClient, RestClient, VocabCache
+from keto_tpu.driver import Config, Registry
+from keto_tpu.engine.shmring import (
+    RingBackend,
+    RingClient,
+    RingError,
+    RingRemoteError,
+    RingServer,
+    WireRing,
+)
+from keto_tpu.graph import SnapshotManager, vocabsync
+from keto_tpu.relationtuple import RelationTuple, SubjectID, SubjectSet
+from keto_tpu.store import InMemoryTupleStore
+from keto_tpu.utils.errors import (
+    DeadlineExceeded,
+    ErrResourceExhausted,
+    ErrVocabEpochMismatch,
+)
+
+
+def _t(s: str) -> RelationTuple:
+    return RelationTuple.from_string(s)
+
+
+# ---------------------------------------------------------------------------
+# wirecodec
+# ---------------------------------------------------------------------------
+
+
+class TestWirecodec:
+    def test_request_roundtrip_minimal(self):
+        s = np.array([1, 2, 3], dtype=np.int32)
+        t = np.array([7, 8, 9], dtype=np.int32)
+        frame = wirecodec.encode_check_request(
+            s, t, lineage="abcd" * 4, epoch=42
+        )
+        req = wirecodec.decode_check_request(frame)
+        assert req.lineage == "abcd" * 4
+        assert req.epoch == 42
+        assert req.min_version == 0
+        assert req.ns is None
+        assert req.depths is None
+        assert req.traceparent is None
+        np.testing.assert_array_equal(req.start, s)
+        np.testing.assert_array_equal(req.target, t)
+
+    def test_request_roundtrip_full(self):
+        rng = np.random.default_rng(3)
+        n = 257
+        s = rng.integers(0, 1 << 20, n).astype(np.int32)
+        t = rng.integers(0, 1 << 20, n).astype(np.int32)
+        ns = rng.integers(-1, 9, n).astype(np.int32)
+        depths = rng.integers(1, 6, n).astype(np.int32)
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        frame = wirecodec.encode_check_request(
+            s,
+            t,
+            lineage="0123456789abcdef",
+            epoch=999_999,
+            ns=ns,
+            depths=depths,
+            min_version=17,
+            traceparent=tp,
+        )
+        req = wirecodec.decode_check_request(frame)
+        assert req.min_version == 17
+        assert req.traceparent == tp
+        np.testing.assert_array_equal(req.start, s)
+        np.testing.assert_array_equal(req.target, t)
+        np.testing.assert_array_equal(req.ns, ns)
+        np.testing.assert_array_equal(req.depths, depths)
+
+    def test_response_bitset_roundtrip(self):
+        for n in (0, 1, 7, 8, 9, 64, 1000):
+            allowed = (np.arange(n) % 3 == 0)
+            frame = wirecodec.encode_check_response(allowed, "z42")
+            got, tok = wirecodec.decode_check_response(frame)
+            assert tok == "z42"
+            np.testing.assert_array_equal(
+                np.asarray(got, dtype=bool), allowed
+            )
+
+    def test_garbage_frames_rejected(self):
+        from keto_tpu.utils.errors import ErrMalformedInput
+
+        for bad in (b"", b"nope", b"KTE1" + b"\x00" * 3):
+            with pytest.raises(ErrMalformedInput):
+                wirecodec.decode_check_request(bad)
+
+
+# ---------------------------------------------------------------------------
+# vocab sync protocol (engine-level, no server)
+# ---------------------------------------------------------------------------
+
+
+class TestVocabSync:
+    def _manager(self):
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(
+            _t("n:doc0#view@(n:team0#member)"),
+            _t("n:team0#member@alice"),
+            _t("m:doc1#view@bob"),
+        )
+        return store, SnapshotManager(store)
+
+    def test_snapshot_page_and_delta_page(self):
+        store, mgr = self._manager()
+        vocab = mgr.snapshot().vocab
+        lineage = vocabsync.lineage_of(vocab)
+        epoch = vocabsync.epoch_of(vocab)
+        assert epoch == len(vocab)
+        page = vocabsync.snapshot_page(vocab, 0, 10_000)
+        assert page["lineage"] == lineage
+        assert page["epoch"] == epoch
+        assert len(page["keys"]) == epoch
+        # delta from the current epoch is empty
+        d = vocabsync.delta_page(vocab, lineage, epoch)
+        assert d["keys"] == []
+        # a write interns new keys; the delta covers exactly them
+        store.write_relation_tuples(_t("n:doc9#view@carol"))
+        vocab2 = mgr.snapshot().vocab
+        d2 = vocabsync.delta_page(vocab2, lineage, epoch)
+        assert vocabsync.epoch_of(vocab2) == epoch + len(d2["keys"])
+        assert len(d2["keys"]) > 0
+
+    def test_delta_wrong_lineage_raises_typed(self):
+        _, mgr = self._manager()
+        vocab = mgr.snapshot().vocab
+        with pytest.raises(ErrVocabEpochMismatch) as ei:
+            vocabsync.delta_page(vocab, "not-the-lineage", 0)
+        details = ei.value.envelope()["error"]["details"]
+        assert details["reason"] == "vocab_epoch_mismatch"
+        assert details["resync"]
+
+    def test_validate_epoch_strictness(self):
+        _, mgr = self._manager()
+        vocab = mgr.snapshot().vocab
+        lineage = vocabsync.lineage_of(vocab)
+        epoch = vocabsync.epoch_of(vocab)
+        vocabsync.validate_epoch(vocab, lineage, epoch)  # exact: ok
+        with pytest.raises(ErrVocabEpochMismatch):
+            vocabsync.validate_epoch(vocab, lineage, epoch - 1)
+        with pytest.raises(ErrVocabEpochMismatch):
+            vocabsync.validate_epoch(vocab, "ffff", epoch)
+
+    def test_ns_table_first_appearance_order(self):
+        _, mgr = self._manager()
+        vocab = mgr.snapshot().vocab
+        table = vocabsync.ns_table_of(vocab)
+        # derived by first appearance over 3-tuple keys in id order —
+        # deterministic, so an independent derivation agrees
+        ids = {table.id_of(name) for name in table.names}
+        assert ids == set(range(len(table)))
+        assert table.id_of("no-such-ns") == vocabsync.NS_UNKNOWN
+
+
+# ---------------------------------------------------------------------------
+# live server: encoded transports, resync drill, parity fuzz
+# ---------------------------------------------------------------------------
+
+
+class _ServerFixture:
+    def __init__(self, config: Config):
+        self.registry = Registry(config)
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(
+            target=self.loop.run_forever, daemon=True
+        )
+        self.thread.start()
+        fut = asyncio.run_coroutine_threadsafe(
+            self.registry.start_all(), self.loop
+        )
+        self.read_port, self.write_port = fut.result(timeout=180)
+        self.http_port = self.registry.read_plane().http_port
+        self.grpc_port = self.registry.read_plane().grpc_port
+
+    def stop(self):
+        asyncio.run_coroutine_threadsafe(
+            self.registry.stop_all(), self.loop
+        ).result(timeout=15)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+_SEED_TUPLES = (
+    "n:doc0#view@(n:team0#member)",
+    "n:team0#member@alice",
+    "n:doc1#view@bob",
+    "m:page0#view@carol",
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = Config(
+        values={
+            "namespaces": [
+                {"id": 1, "name": "n"},
+                {"id": 2, "name": "m"},
+            ],
+            "log": {"level": "error"},
+            "serve": {
+                "read": {"port": 0, "host": "127.0.0.1"},
+                "write": {"port": 0, "host": "127.0.0.1"},
+            },
+        }
+    )
+    s = _ServerFixture(cfg)
+    s.registry.store().write_relation_tuples(
+        *[_t(x) for x in _SEED_TUPLES]
+    )
+    yield s
+    s.stop()
+
+
+def _fresh_reqs(i: int):
+    """A batch with hits, misses, and a subject-set row."""
+    return [
+        _t("n:doc0#view@alice"),
+        _t("n:doc0#view@bob"),
+        _t("n:doc1#view@bob"),
+        _t("m:page0#view@carol"),
+        _t(f"n:doc{i}#view@nobody-{i}"),
+        _t("n:doc0#view@(n:team0#member)"),
+    ]
+
+
+class TestLiveEncoded:
+    def test_vocab_endpoints_page_and_sync(self, server):
+        base = f"http://127.0.0.1:{server.read_port}"
+        with httpx.Client(base_url=base, timeout=30) as c:
+            first = c.get(
+                "/vocab/snapshot", params={"offset": 0, "limit": 2}
+            ).json()
+            assert first["lineage"] and first["epoch"] > 2
+            assert len(first["keys"]) == 2
+            # paging covers the whole epoch
+            total, offset = len(first["keys"]), 2
+            while offset < first["epoch"]:
+                page = c.get(
+                    "/vocab/snapshot",
+                    params={"offset": offset, "limit": 1000},
+                ).json()
+                total += len(page["keys"])
+                offset += len(page["keys"])
+            assert total == first["epoch"]
+            # delta endpoint: wrong lineage is the typed 409
+            r = c.get(
+                "/vocab/deltas",
+                params={"lineage": "beef" * 4, "from": 0},
+            )
+            assert r.status_code == 409
+            details = r.json()["error"]["details"]
+            assert details["reason"] == "vocab_epoch_mismatch"
+            assert "snapshot" in details["resync"]
+
+    def test_cache_bootstrap_matches_server_vocab(self, server):
+        with VocabCache(
+            f"http://127.0.0.1:{server.read_port}", page_size=3
+        ) as cache:
+            cache.bootstrap()
+            vocab = server.registry.snapshots().snapshot().vocab
+            assert cache.lineage == vocabsync.lineage_of(vocab)
+            assert cache.epoch == vocabsync.epoch_of(vocab)
+            s_ids, t_ids, ns_ids = cache.encode(
+                [_t("n:doc0#view@alice"), _t("zzz:q#r@nobody")]
+            )
+            # known rows resolve to the server's ids; unknown to -1
+            assert s_ids[0] == vocab.lookup(("n", "doc0", "view"))
+            assert t_ids[0] == vocab.lookup(("alice",))
+            assert s_ids[1] == -1 and t_ids[1] == -1
+            table = vocabsync.ns_table_of(vocab)
+            assert ns_ids[0] == table.id_of("n")
+            assert ns_ids[1] == vocabsync.NS_UNKNOWN
+
+    def test_rest_and_grpc_encoded_parity_fuzz(self, server):
+        rest = RestClient(
+            f"http://127.0.0.1:{server.http_port}",
+            f"http://127.0.0.1:{server.write_port}",
+        )
+        gc = GrpcClient(
+            f"127.0.0.1:{server.grpc_port}",
+            f"127.0.0.1:{server.write_port}",
+        )
+        try:
+            cache = rest.vocab_cache()
+            cache.bootstrap()
+            for i in range(4):
+                reqs = _fresh_reqs(i)
+                want = rest.batch_check(reqs)
+                got_rest = rest.batch_check_encoded(cache, reqs)
+                got_grpc = gc.batch_check_encoded(cache, reqs)
+                assert got_rest == want, f"REST round {i}"
+                assert [bool(v) for v in got_grpc] == want, (
+                    f"gRPC round {i}"
+                )
+        finally:
+            rest.close()
+            gc.close()
+
+    def test_stale_epoch_bounced_then_resynced_rest(self, server):
+        base = f"http://127.0.0.1:{server.read_port}"
+        store = server.registry.store()
+        with VocabCache(base) as cache:
+            cache.bootstrap()
+            reqs = [_t("n:doc0#view@alice"), _t("n:fresh0#view@dave")]
+            s_ids, t_ids, ns_ids = cache.encode(reqs)
+            stale_frame = wirecodec.encode_check_request(
+                s_ids,
+                t_ids,
+                lineage=cache.lineage,
+                epoch=cache.epoch,
+                ns=ns_ids,
+            )
+            # the drill: a write lands between encode() and the request
+            store.write_relation_tuples(_t("n:fresh0#view@dave"))
+            with httpx.Client(base_url=base, timeout=30) as c:
+                r = c.post(
+                    "/check/batch-encoded",
+                    content=stale_frame,
+                    headers={
+                        "Content-Type": "application/octet-stream"
+                    },
+                )
+                assert r.status_code == 409
+                details = r.json()["error"]["details"]
+                assert details["reason"] == "vocab_epoch_mismatch"
+                assert details["server_epoch"] > details["client_epoch"]
+            # sync() follows the delta feed; the re-encoded request now
+            # resolves the fresh keys and succeeds
+            cache.sync()
+            vocab = server.registry.snapshots().snapshot().vocab
+            assert cache.epoch == vocabsync.epoch_of(vocab)
+            with RestClient(
+                f"http://127.0.0.1:{server.http_port}",
+                f"http://127.0.0.1:{server.write_port}",
+            ) as rest:
+                assert rest.batch_check_encoded(cache, reqs) == [
+                    True,
+                    True,
+                ]
+
+    def test_stale_epoch_client_resyncs_transparently_grpc(self, server):
+        gc = GrpcClient(
+            f"127.0.0.1:{server.grpc_port}",
+            f"127.0.0.1:{server.write_port}",
+        )
+        try:
+            with VocabCache(
+                f"http://127.0.0.1:{server.read_port}"
+            ) as cache:
+                cache.bootstrap()
+                reqs = [
+                    _t("n:doc0#view@alice"),
+                    _t("n:fresh1#view@erin"),
+                ]
+                # the cache is now stale: this write interns new keys
+                server.registry.store().write_relation_tuples(
+                    _t("n:fresh1#view@erin")
+                )
+                got = gc.batch_check_encoded(cache, reqs)
+                assert [bool(v) for v in got] == [True, True]
+        finally:
+            gc.close()
+
+    def test_raw_grpc_stale_epoch_is_failed_precondition(self, server):
+        with VocabCache(
+            f"http://127.0.0.1:{server.read_port}"
+        ) as cache:
+            cache.bootstrap()
+            frame = wirecodec.encode_check_request(
+                np.array([0], dtype=np.int32),
+                np.array([1], dtype=np.int32),
+                lineage=cache.lineage,
+                epoch=cache.epoch + 5,  # from the future: never valid
+            )
+        with grpc.insecure_channel(
+            f"127.0.0.1:{server.grpc_port}"
+        ) as ch:
+            rpc = ch.unary_unary(
+                f"/{_PKG}.CheckService/BatchCheckEncoded"
+            )
+            with pytest.raises(grpc.RpcError) as ei:
+                rpc(frame)
+            assert (
+                ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+            )
+            details = dict(ei.value.trailing_metadata() or ())
+            assert "keto-error-details" in details
+
+    def test_attribution_covers_encoded_path(self, server):
+        """Flight/attribution rides the encoded transports: coverage
+        stays high and the encode stage is ~0 (ids came pre-encoded)."""
+        rest = RestClient(
+            f"http://127.0.0.1:{server.http_port}",
+            f"http://127.0.0.1:{server.write_port}",
+        )
+        try:
+            cache = rest.vocab_cache()
+            cache.bootstrap()
+            for i in range(10):
+                rest.batch_check_encoded(cache, _fresh_reqs(i % 3))
+        finally:
+            rest.close()
+        with httpx.Client(timeout=30) as c:
+            debug = c.get(
+                f"http://127.0.0.1:{server.http_port}"
+                "/debug/attribution"
+            ).json()["attribution"]
+            flights = c.get(
+                f"http://127.0.0.1:{server.http_port}"
+                "/debug/flight?n=10"
+            ).json()
+        assert debug["requests"] >= 10
+        assert debug["coverage"] >= 0.95
+        stages = debug.get("stages") or {}
+        encode_s = (stages.get("encode") or {}).get("seconds", 0.0)
+        assert encode_s < 0.05, "encoded path must not pay encode time"
+        recs = flights.get("flights") or flights.get("records") or []
+        assert any(
+            r.get("transport") == "rest-encoded" for r in recs
+        ), recs
+
+
+# ---------------------------------------------------------------------------
+# QoS on the encoded path (no strings on the wire)
+# ---------------------------------------------------------------------------
+
+
+class TestEncodedQos:
+    def test_ns_counts_from_id_column(self):
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(
+            _t("a:o#r@u1"), _t("b:o#r@u1"), _t("b:o2#r@u2")
+        )
+        mgr = SnapshotManager(store)
+        vocab = mgr.snapshot().vocab
+        table = vocabsync.ns_table_of(vocab)
+        ids = np.array(
+            [table.id_of("a"), table.id_of("b"), table.id_of("b"), -1],
+            dtype=np.int32,
+        )
+        counts = EncodedCheckFront.ns_counts(vocab, ids)
+        assert counts["a"] == 1
+        assert counts["b"] == 2
+        assert counts[vocabsync.NS_UNKNOWN_LABEL] == 1
+        assert EncodedCheckFront.ns_counts(vocab, None) is None
+
+    def test_encoded_batch_throttled_per_tenant(self):
+        """A drained tenant 429s on the encoded path and the throttle
+        counter names it — all derived from the id column."""
+        from keto_tpu.engine.batcher import CheckBatcher
+        from keto_tpu.engine.closure import ClosureCheckEngine
+        from keto_tpu.engine.qos import NamespaceQos, QosThrottled
+
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(
+            _t("hot:o#r@u1"), _t("cold:o#r@u1")
+        )
+        mgr = SnapshotManager(store)
+        qos = NamespaceQos(
+            rate=0.001, burst=4.0
+        )  # ~4 rows, then drained
+        batcher = CheckBatcher(ClosureCheckEngine(mgr), qos=qos)
+        try:
+            front = EncodedCheckFront(mgr, batcher)
+            vocab = mgr.snapshot().vocab
+            table = vocabsync.ns_table_of(vocab)
+            hot = table.id_of("hot")
+            lineage = vocabsync.lineage_of(vocab)
+            epoch = vocabsync.epoch_of(vocab)
+
+            def frame(n):
+                return wirecodec.decode_check_request(
+                    wirecodec.encode_check_request(
+                        np.zeros(n, dtype=np.int32),
+                        np.ones(n, dtype=np.int32),
+                        lineage=lineage,
+                        epoch=epoch,
+                        ns=np.full(n, hot, dtype=np.int32),
+                    )
+                )
+
+            front.check(frame(4))  # burst admits
+            with pytest.raises(QosThrottled) as ei:
+                front.check(frame(4))
+            assert ei.value.namespace == "hot"
+            assert ei.value.status_code == 429
+            assert qos.stats()["throttled"].get("hot", 0) >= 1
+        finally:
+            batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# shm ring fault drills
+# ---------------------------------------------------------------------------
+
+
+def _echo_handler(frame: bytes) -> bytes:
+    return b"echo:" + frame
+
+
+class TestWireRing:
+    def test_roundtrip_and_remote_stages(self):
+        ring = WireRing(2, slots_per_endpoint=2, slot_bytes=4096)
+        server = RingServer(ring, _echo_handler)
+        server.start()
+        clients = [
+            RingClient(ring, ring.endpoints[0]),
+            RingClient(ring, ring.endpoints[1]),
+        ]
+        try:
+            for i, cl in enumerate(clients):
+                payload = cl.submit(f"frame-{i}".encode(), timeout=10)
+                kind, body, stages = pickle.loads(payload)
+                assert kind == "ok"
+                assert body == f"echo:frame-{i}".encode()
+                assert isinstance(stages, dict)
+        finally:
+            for cl in clients:
+                cl.close()
+            server.stop()
+            ring.close()
+
+    def test_remote_error_revives_typed(self):
+        def boom(frame):
+            raise ErrResourceExhausted("device is saturated")
+
+        ring = WireRing(1, slot_bytes=4096)
+        server = RingServer(ring, boom)
+        server.start()
+        cl = RingClient(ring, ring.endpoints[0])
+        try:
+            payload = cl.submit(b"x", timeout=10)
+            kind, shipped, _ = pickle.loads(payload)
+            assert kind == "err"
+            err = RingRemoteError(shipped)
+            assert err.status_code == 429
+            assert err.grpc_code == "RESOURCE_EXHAUSTED"
+            assert "saturated" in str(err)
+        finally:
+            cl.close()
+            server.stop()
+            ring.close()
+
+    def test_parent_death_fails_pending_futures_typed(self):
+        """Worker die-mid-batch drill, seen from the worker: the parent
+        vanishes while a request is in flight. Every pending future must
+        fail with the typed RingError — no lost futures."""
+        hold = threading.Event()
+
+        def stuck(frame):
+            hold.wait(10)
+            return b"late"
+
+        ring = WireRing(1, slots_per_endpoint=2, slot_bytes=4096)
+        server = RingServer(ring, stuck)
+        server.start()
+        cl = RingClient(ring, ring.endpoints[0])
+        errs = []
+
+        def call():
+            try:
+                cl.submit(b"x", timeout=30)
+            except BaseException as e:
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=call, daemon=True) for _ in range(2)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.2)
+            # parent dies: its doorbell ends close under the stuck handler
+            for ep in ring.endpoints:
+                ep.parent_sock.close()
+            for t in threads:
+                t.join(timeout=10)
+            assert len(errs) == 2
+            assert all(isinstance(e, RingError) for e in errs), errs
+            assert all(e.status_code == 503 for e in errs)
+            with pytest.raises(RingError):
+                cl.submit(b"y", timeout=1)  # broken ring stays typed
+        finally:
+            hold.set()
+            cl.close()
+            server._stopping = True
+            ring.close()
+
+    def test_dead_worker_retires_only_its_lane(self):
+        ring = WireRing(2, slot_bytes=4096)
+        server = RingServer(ring, _echo_handler)
+        server.start()
+        cl0 = RingClient(ring, ring.endpoints[0])
+        cl1 = RingClient(ring, ring.endpoints[1])
+        try:
+            cl0.submit(b"a", timeout=10)
+            cl1.close()  # worker 1 dies
+            time.sleep(0.2)
+            # worker 0's lane keeps serving
+            payload = cl0.submit(b"b", timeout=10)
+            assert pickle.loads(payload)[0] == "ok"
+        finally:
+            cl0.close()
+            server.stop()
+            ring.close()
+
+    def test_slot_exhaustion_is_retryable_429(self):
+        hold = threading.Event()
+
+        def stuck(frame):
+            hold.wait(10)
+            return b"done"
+
+        ring = WireRing(1, slots_per_endpoint=1, slot_bytes=4096)
+        server = RingServer(ring, stuck)
+        server.start()
+        cl = RingClient(ring, ring.endpoints[0])
+        t = threading.Thread(
+            target=lambda: cl.submit(b"x", timeout=30), daemon=True
+        )
+        try:
+            t.start()
+            time.sleep(0.2)  # the only slot is now leased
+            t0 = time.monotonic()
+            with pytest.raises(ErrResourceExhausted) as ei:
+                cl.submit(b"y", timeout=0.3)
+            assert time.monotonic() - t0 < 5
+            assert ei.value.status_code == 429
+        finally:
+            hold.set()
+            t.join(timeout=10)
+            cl.close()
+            server.stop()
+            ring.close()
+
+    def test_deadline_leaves_slot_leased_until_ack(self):
+        release = threading.Event()
+
+        def slow(frame):
+            release.wait(10)
+            return b"slow"
+
+        ring = WireRing(1, slots_per_endpoint=1, slot_bytes=4096)
+        server = RingServer(ring, slow)
+        server.start()
+        cl = RingClient(ring, ring.endpoints[0])
+        try:
+            with pytest.raises(DeadlineExceeded):
+                cl.submit(b"x", timeout=0.2)
+            # slot still leased: the late response must not collide with
+            # a reused slot, so the next submit cannot grab it yet
+            with pytest.raises(ErrResourceExhausted):
+                cl.submit(b"y", timeout=0.3)
+            release.set()  # parent answers; the ack recycles the slot
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                try:
+                    payload = cl.submit(b"z", timeout=1.0)
+                    break
+                except (ErrResourceExhausted, DeadlineExceeded):
+                    time.sleep(0.05)
+            else:
+                pytest.fail("slot never recycled after the late ack")
+            assert pickle.loads(payload)[0] == "ok"
+        finally:
+            release.set()
+            cl.close()
+            server.stop()
+            ring.close()
+
+    def test_ring_backend_merges_remote_stages(self):
+        """The worker-side ledger stays conserved across the hop: remote
+        stage seconds fold in, the hop residual books to queue."""
+        from keto_tpu.telemetry.attribution import (
+            TimeLedger,
+            reset_current_ledger,
+            set_current_ledger,
+        )
+
+        def handler(frame):
+            from keto_tpu.telemetry.attribution import ledger_mark
+
+            time.sleep(0.02)
+            ledger_mark("kernel")
+            return wirecodec.encode_check_response(
+                np.array([True, False]), "z1"
+            )
+
+        ring = WireRing(1, slot_bytes=4096)
+        server = RingServer(ring, handler)
+        server.start()
+        cl = RingClient(ring, ring.endpoints[0])
+        try:
+            backend = RingBackend(cl)
+            req = wirecodec.decode_check_request(
+                wirecodec.encode_check_request(
+                    np.array([0, 1], dtype=np.int32),
+                    np.array([2, 3], dtype=np.int32),
+                    lineage="ab" * 8,
+                    epoch=4,
+                )
+            )
+            led = TimeLedger()
+            token = set_current_ledger(led)
+            try:
+                allowed = backend.ring_submit(
+                    req, req.start, req.target, timeout=10
+                )
+            finally:
+                reset_current_ledger(token)
+            assert [bool(v) for v in allowed] == [True, False]
+            assert led.stages.get("kernel", 0) >= 0.015
+            assert "queue" in led.stages
+        finally:
+            cl.close()
+            server.stop()
+            ring.close()
+
+
+# ---------------------------------------------------------------------------
+# ring-mode front: QoS deferred to the parent, no double debit
+# ---------------------------------------------------------------------------
+
+
+class TestRingFront:
+    def test_front_defers_qos_to_ring(self):
+        """In a wire worker the front must NOT derive/debit ns_counts —
+        the parent debits once from the frame's ns column."""
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(_t("n:o#r@u"))
+        mgr = SnapshotManager(store)
+        vocab = mgr.snapshot().vocab
+        seen = {}
+
+        class FakeRingBackend:
+            def ring_submit(self, req, start, target, timeout=None):
+                seen["ns"] = req.ns
+                return np.array([False] * len(start))
+
+        front = EncodedCheckFront(mgr, FakeRingBackend())
+        req = wirecodec.decode_check_request(
+            wirecodec.encode_check_request(
+                np.array([0], dtype=np.int32),
+                np.array([1], dtype=np.int32),
+                lineage=vocabsync.lineage_of(vocab),
+                epoch=vocabsync.epoch_of(vocab),
+                ns=np.array([0], dtype=np.int32),
+            )
+        )
+        got = front.check(req)
+        assert list(got) == [False]
+        # the ns column crossed the hop intact for the parent's debit
+        np.testing.assert_array_equal(seen["ns"], [0])
+
+    def test_parent_front_skips_epoch_gate(self):
+        """validate=False (the parent ring consumer): an older-but-same-
+        lineage epoch must pass — the worker already gated it."""
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(_t("n:o#r@u"))
+        mgr = SnapshotManager(store)
+        vocab = mgr.snapshot().vocab
+        lineage = vocabsync.lineage_of(vocab)
+        old_epoch = vocabsync.epoch_of(vocab)
+        store.write_relation_tuples(_t("n:o2#r@u2"))  # epoch moves on
+
+        class Oracle:
+            def check_batch_encoded(
+                self, s, t, depths=None, min_version=0, timeout=None,
+                ns_counts=None,
+            ):
+                return np.array([True] * len(s))
+
+        req = wirecodec.decode_check_request(
+            wirecodec.encode_check_request(
+                np.array([0], dtype=np.int32),
+                np.array([1], dtype=np.int32),
+                lineage=lineage,
+                epoch=old_epoch,
+            )
+        )
+        strict = EncodedCheckFront(mgr, Oracle())
+        with pytest.raises(ErrVocabEpochMismatch):
+            strict.check(req)
+        relaxed = EncodedCheckFront(mgr, Oracle(), validate=False)
+        assert list(relaxed.check(req)) == [True]

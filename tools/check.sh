@@ -9,7 +9,10 @@
 #      the wall-clock accounting ledger (/debug/attribution) must cover
 #      >= 95% of measured check wall time, else bench.py exits 3 — a
 #      refactor that drops a stage's ledger marks fails here, not in
-#      production
+#      production. Also the encoded-wire parity gate: the id-native
+#      BatchCheckEncoded leg must answer identically to the per-tuple
+#      string path on both transports (encoded_parity == ok) or bench
+#      exits 3
 #   3. chaos soak smoke — tools/soak.py: seeded deterministic fault
 #      schedule (crash/slow/nan + pool-phase drop/crash) under concurrent
 #      mixed load; answer parity, snaptoken monotonicity, no lost
@@ -43,6 +46,14 @@ cd "$(dirname "$0")/.."
 
 echo "== import hygiene =="
 JAX_PLATFORMS=cpu python tools/verify_imports.py || exit 1
+
+echo "== encoded wire parity =="
+# fast-fail version of the bench encoded_parity gate: the id-native wire
+# tier (vocab sync + BatchCheckEncoded on REST and gRPC) must agree with
+# the per-tuple string path before anything slower runs
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_wire_encoded.py -q -p no:cacheprovider \
+  -k "parity or resync or stale" || exit 1
 
 echo "== bench smoke =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python bench.py --smoke || exit 1
