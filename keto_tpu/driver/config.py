@@ -255,6 +255,34 @@ CONFIG_SCHEMA = {
                     },
                     "additionalProperties": False,
                 },
+                # sharded serving tier (parallel/serving.py): route live
+                # check traffic through the edge-partitioned mesh closure
+                # engine; auto-falls back to the single-chip engine when
+                # the mesh has one device
+                "sharding": {
+                    "type": "object",
+                    "properties": {
+                        "enabled": {"type": "boolean"},
+                        # mesh axes, same semantics as engine.mesh.*:
+                        # data = batch parallelism, edge = node stripes
+                        # (0 = all remaining devices)
+                        "data": {"type": "integer", "minimum": 1},
+                        "edge": {"type": "integer", "minimum": 0},
+                        # values gathered per re-stripe chunk, bounding
+                        # one incremental re-shard's temporaries
+                        # (0 = unchunked)
+                        "edge_chunk": {"type": "integer", "minimum": 0},
+                        # tolerated host-oracle escalation fraction per
+                        # batch before the breach is logged/counted —
+                        # the rebalance alarm signal
+                        "escalation_budget": {
+                            "type": "number",
+                            "minimum": 0,
+                            "maximum": 1,
+                        },
+                    },
+                    "additionalProperties": False,
+                },
                 # HBM admission control (engine/hbm.py): budget check-batch
                 # device memory BEFORE the XLA allocator sees it
                 "memory": {
@@ -546,6 +574,11 @@ DEFAULTS = {
     "engine.compile_cache_dir": "",
     "engine.mesh.data": 1,
     "engine.mesh.edge": 0,
+    "engine.sharding.enabled": False,
+    "engine.sharding.data": 1,
+    "engine.sharding.edge": 0,
+    "engine.sharding.edge_chunk": 0,
+    "engine.sharding.escalation_budget": 0.05,
     "engine.memory.admission": True,
     "engine.memory.hbm_budget_frac": 0.8,
     "engine.memory.bytes_per_row": 4096,

@@ -841,6 +841,64 @@ class Registry:
         if self._check_engine is None:
             max_depth = self.config.read_api_max_depth()
             mode = self.config.engine_mode()
+            if (
+                bool(
+                    self.config.get(
+                        "engine.sharding.enabled", default=False
+                    )
+                )
+                and mode != "host"
+            ):
+                # sharded serving tier: live check traffic through the
+                # edge-partitioned mesh closure engine. One-device
+                # "meshes" fall through to the single-chip engines below
+                # — sharding overhead with no stripes to spread is pure
+                # loss, and CI hosts must not need mesh env flags
+                try:
+                    import jax
+
+                    n_devices = len(jax.devices())
+                except Exception:
+                    n_devices = 1
+                if n_devices >= 2:
+                    from ..parallel import ShardedServingEngine, make_mesh
+
+                    data = int(
+                        self.config.get("engine.sharding.data", default=1)
+                    )
+                    edge = (
+                        int(
+                            self.config.get(
+                                "engine.sharding.edge", default=0
+                            )
+                        )
+                        or None
+                    )
+                    self._check_engine = ShardedServingEngine(
+                        self.snapshots(),
+                        mesh=make_mesh(data=data, edge=edge),
+                        max_depth=max_depth,
+                        edge_chunk=int(
+                            self.config.get(
+                                "engine.sharding.edge_chunk", default=0
+                            )
+                        ),
+                        escalation_budget=float(
+                            self.config.get(
+                                "engine.sharding.escalation_budget",
+                                default=0.05,
+                            )
+                        ),
+                        hbm=self.hbm_admission(),
+                        metrics=self.metrics(),
+                        logger=self.logger(),
+                    )
+                    return self._check_engine
+                self.logger().info(
+                    "engine.sharding enabled but mesh has one device; "
+                    "serving single-chip",
+                    devices=n_devices,
+                )
             if mode == "host":
                 self._check_engine = CheckEngine(self.store(), max_depth=max_depth)
             elif mode in ("closure", "auto"):

@@ -16,7 +16,12 @@ the budget *before* the allocator:
 - admission clamps the batcher's chunk size so an oversized caller batch is
   pre-split *before* encode instead of OOMing in launch, and
   :meth:`wait_for_headroom` lets the closure engine serialize its rebuild
-  against in-flight batch memory so rebuild + serving can't co-OOM.
+  against in-flight batch memory so rebuild + serving can't co-OOM;
+- the sharded serving tier (parallel/serving.py) pushes its measured
+  per-shard residency in via :meth:`set_shard_residency`, and per-device
+  peak samples teach a per-(bucket, snapshot, shard) model — admission then
+  respects the headroom of the *fullest* shard (the one that OOMs first),
+  not the mesh average.
 
 On hosts without device memory stats (CPU test meshes return ``None``)
 every admission question degrades to "yes, unlimited" at the cost of one
@@ -70,9 +75,17 @@ class HbmAdmission:
         self._calibrated_at: float = float("-inf")
         # (bucket, snapshot-version) -> modeled bytes for one such batch
         self._model: dict[tuple[int, int], float] = {}
-        # token -> (modeled cost, shape key, peak sample at reserve time)
+        # (bucket, snapshot-version, shard) -> modeled per-shard peak for
+        # one such batch (sharded serving tier; shard = device index)
+        self._shard_model: dict[tuple[int, int, int], float] = {}
+        # shard -> resident bytes the sharded tier pinned on that device
+        # (D replica + CSR stripes); admission subtracts the fullest
+        # shard's residency from the budget
+        self._shard_residency: dict[int, float] = {}
+        # token -> (modeled cost, shape key, per-device peak samples at
+        # reserve time — None when no device reports memory stats)
         self._inflight: dict[
-            int, tuple[float, tuple[int, int], Optional[float]]
+            int, tuple[float, tuple[int, int], Optional[list]]
         ] = {}
         self._inflight_bytes = 0.0
         self._next_token = 0
@@ -159,31 +172,78 @@ class HbmAdmission:
                 (1 - _EMA_ALPHA) * self._bytes_per_row + _EMA_ALPHA * per_row
             )
 
-    def _peak_bytes(self) -> Optional[float]:
-        """Current peak_bytes_in_use, or None when no device reports
-        memory stats (a peak of 0 on a fresh process is a real sample)."""
+    def _peak_by_shard(self) -> Optional[list]:
+        """Per-device peak_bytes_in_use samples (device order = shard
+        order), or None when no device reports memory stats (a peak of 0
+        on a fresh process is a real sample)."""
+        peaks = []
         try:
             for dev in self._devstats.sample_devices():
                 stats = dev.get("memory_stats")
                 if stats:
-                    return float(stats.get("peak_bytes_in_use") or 0)
+                    peaks.append(float(stats.get("peak_bytes_in_use") or 0))
         except Exception:
-            pass
-        return None
+            return None
+        return peaks or None
+
+    def _peak_bytes(self) -> Optional[float]:
+        """Current peak_bytes_in_use of the first reporting device."""
+        peaks = self._peak_by_shard()
+        return None if peaks is None else peaks[0]
+
+    def _observe_shard_peaks(
+        self, key: tuple[int, int], before: list, after: list
+    ) -> None:
+        """Fold per-device peak deltas for one batch into the
+        per-(bucket, snapshot, shard) model — the sharded tier's batches
+        land on every shard at once, and the shard that peaked highest is
+        the one a bigger batch OOMs first."""
+        with self._lock:
+            for shard, (b, a) in enumerate(zip(before, after)):
+                delta = a - b
+                if delta <= 0:
+                    continue
+                skey = (key[0], key[1], shard)
+                old = self._shard_model.get(skey)
+                self._shard_model[skey] = (
+                    delta
+                    if old is None
+                    else (1 - _EMA_ALPHA) * old + _EMA_ALPHA * delta
+                )
+            while len(self._shard_model) > 1024:
+                self._shard_model.pop(next(iter(self._shard_model)))
 
     # -- admission -------------------------------------------------------------
 
+    def set_shard_residency(self, residency: dict) -> None:
+        """The sharded serving tier reports its measured per-shard
+        resident bytes (replicated D + that shard's CSR stripes) after
+        every re-shard; admission subtracts the FULLEST shard — the
+        smallest-headroom device is the one a batch OOMs on."""
+        with self._lock:
+            self._shard_residency = {
+                int(k): float(v) for k, v in residency.items()
+            }
+            self._headroom_wake.notify_all()
+
+    def _resident_floor_locked(self) -> float:
+        return max(self._shard_residency.values(), default=0.0)
+
     def clamp_rows(self, rows: int) -> int:
         """Largest batch (<= ``rows``) whose modeled footprint fits the
-        budget headroom left by in-flight batches — the batcher's chunk
-        loops call this per chunk, so an oversized caller batch is
-        pre-split at admission instead of OOMing in launch."""
+        budget headroom left by in-flight batches and the fullest shard's
+        residency — the batcher's chunk loops call this per chunk, so an
+        oversized caller batch is pre-split at admission instead of
+        OOMing in launch."""
         with self._lock:
             self._calibrate_locked()
             budget = self._budget_bytes
             if budget is None or rows <= _MIN_ROWS:
                 return rows
-            headroom = max(0.0, budget - self._inflight_bytes)
+            headroom = max(
+                0.0,
+                budget - self._inflight_bytes - self._resident_floor_locked(),
+            )
             per_row = max(1.0, self._bytes_per_row)
             fit = int(headroom / per_row)
             if fit >= rows:
@@ -208,10 +268,10 @@ class HbmAdmission:
             self._next_token += 1
             token = self._next_token
             self._inflight[token] = (cost, (bucket, version), None)
-        peak = self._peak_bytes()
+        peaks = self._peak_by_shard()
         with self._lock:
             if token in self._inflight:
-                self._inflight[token] = (cost, (bucket, version), peak)
+                self._inflight[token] = (cost, (bucket, version), peaks)
                 self._inflight_bytes += cost
         return token
 
@@ -222,12 +282,22 @@ class HbmAdmission:
             entry = self._inflight.pop(token, None)
             if entry is None:
                 return
-            cost, key, peak_before = entry
+            cost, key, peaks_before = entry
             self._inflight_bytes = max(0.0, self._inflight_bytes - cost)
             self._headroom_wake.notify_all()
-        peak_after = self._peak_bytes()
-        if peak_before is not None and peak_after is not None:
-            self._observe_peak_delta(key, peak_after - peak_before)
+        peaks_after = self._peak_by_shard()
+        if peaks_before is not None and peaks_after is not None:
+            self._observe_peak_delta(key, peaks_after[0] - peaks_before[0])
+            if len(peaks_before) > 1:
+                self._observe_shard_peaks(key, peaks_before, peaks_after)
+
+    def modeled_shard_bytes(
+        self, bucket: int, version: int, shard: int
+    ) -> Optional[float]:
+        """The learned per-shard peak for one (bucket, snapshot, shard)
+        batch shape, or None before any observation."""
+        with self._lock:
+            return self._shard_model.get((bucket, version, shard))
 
     # -- rebuild gating --------------------------------------------------------
 
@@ -269,4 +339,7 @@ class HbmAdmission:
                 ),
                 "bytes_per_row": round(self._bytes_per_row, 1),
                 "modeled_shapes": len(self._model),
+                "shard_residency": dict(self._shard_residency),
+                "resident_floor_bytes": self._resident_floor_locked(),
+                "modeled_shard_shapes": len(self._shard_model),
             }

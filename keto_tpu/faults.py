@@ -74,6 +74,10 @@ site                      effect when armed
                           half-written tmp file before the atomic rename;
                           readers must keep seeing the previous checkpoint
                           (graph/checkpoint.py)
+``shard.launch_fail``     a sharded serving-tier launch raises before the
+                          mesh dispatch; the breaker must answer the batch
+                          from the host oracle and re-probe the mesh path
+                          (parallel/serving.py + engine/fallback.py)
 ========================  ====================================================
 
 Slowness sites (armed with :meth:`FaultRegistry.arm_slow`, consumed with
@@ -99,6 +103,10 @@ site                      seam that honors it when armed
                           frame (driver/replicas.py)
 ``replica.slow``          a serving replica stalls before answering a check
                           (driver/replicas.py) — the hedging drill's seam
+``shard.launch_slow``     a sharded serving-tier launch stalls before the
+                          mesh dispatch — models a straggling shard, the
+                          deadline plane's cross-mesh seam
+                          (parallel/serving.py)
 ========================  ====================================================
 
 ``KETO_FAULTS`` syntax: comma-separated entries, each one of

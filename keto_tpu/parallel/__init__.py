@@ -9,11 +9,13 @@ program — no host round-trips between steps.
 """
 
 from .closure_sharded import ShardedClosureEngine
+from .serving import ShardedServingEngine
 from .sharded import ShardedCheckEngine, make_mesh, sharded_check
 
 __all__ = [
     "ShardedCheckEngine",
     "ShardedClosureEngine",
+    "ShardedServingEngine",
     "make_mesh",
     "sharded_check",
 ]

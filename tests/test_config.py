@@ -33,3 +33,66 @@ class TestConfigDefaults:
             flag_overrides={"serve.read.port": 1111},
         )
         assert c.get("serve.read.port") == 1111
+
+
+class TestShardingConfig:
+    def test_sharding_defaults(self):
+        c = Config(values={}, env={})
+        assert c.get("engine.sharding.enabled") is False
+        assert c.get("engine.sharding.data") == 1
+        assert c.get("engine.sharding.edge") == 0
+        assert c.get("engine.sharding.edge_chunk") == 0
+        assert c.get("engine.sharding.escalation_budget") == 0.05
+
+    def test_sharding_values_round_trip(self):
+        c = Config(
+            values={
+                "engine": {
+                    "sharding": {
+                        "enabled": True,
+                        "data": 2,
+                        "edge": 4,
+                        "edge_chunk": 1 << 20,
+                        "escalation_budget": 0.01,
+                    }
+                }
+            },
+            env={},
+        )
+        assert c.get("engine.sharding.enabled") is True
+        assert c.get("engine.sharding.data") == 2
+        assert c.get("engine.sharding.edge") == 4
+        assert c.get("engine.sharding.edge_chunk") == 1 << 20
+        assert c.get("engine.sharding.escalation_budget") == 0.01
+
+    def test_sharding_env_override(self):
+        c = Config(
+            values={}, env={"KETO_ENGINE_SHARDING_ENABLED": "true"}
+        )
+        assert c.get("engine.sharding.enabled") in (True, "true")
+
+    def test_sharding_keys_in_exported_schema(self):
+        from keto_tpu.driver.config import CONFIG_SCHEMA
+
+        props = CONFIG_SCHEMA["properties"]["engine"]["properties"]
+        sharding = props["sharding"]["properties"]
+        assert set(sharding) == {
+            "enabled", "data", "edge", "edge_chunk", "escalation_budget"
+        }
+        # misspelled keys must be rejected, same as every engine block
+        assert props["sharding"]["additionalProperties"] is False
+
+    def test_sharding_keys_validate(self):
+        import jsonschema
+        import pytest
+        from keto_tpu.driver.config import CONFIG_SCHEMA
+
+        jsonschema.validate(
+            {"engine": {"sharding": {"enabled": True, "data": 2}}},
+            CONFIG_SCHEMA,
+        )
+        with pytest.raises(jsonschema.ValidationError):
+            jsonschema.validate(
+                {"engine": {"sharding": {"escalation_budget": 2.0}}},
+                CONFIG_SCHEMA,
+            )

@@ -9,8 +9,7 @@ import sys
 import pytest
 
 
-@pytest.mark.timeout(600)
-def test_multichip_suite_on_virtual_mesh():
+def _run_on_virtual_mesh(test_file: str) -> None:
     env = dict(os.environ)
     env.update(
         {
@@ -24,7 +23,7 @@ def test_multichip_suite_on_virtual_mesh():
             sys.executable,
             "-m",
             "pytest",
-            os.path.join(os.path.dirname(__file__), "test_multichip_sharded.py"),
+            os.path.join(os.path.dirname(__file__), test_file),
             "-q",
             "--no-header",
         ],
@@ -35,6 +34,16 @@ def test_multichip_suite_on_virtual_mesh():
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert proc.returncode == 0, (
-        f"multichip suite failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        f"{test_file} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
     )
     assert "skipped" not in proc.stdout.lower() or "passed" in proc.stdout
+
+
+@pytest.mark.timeout(600)
+def test_multichip_suite_on_virtual_mesh():
+    _run_on_virtual_mesh("test_multichip_sharded.py")
+
+
+@pytest.mark.timeout(600)
+def test_sharded_serving_suite_on_virtual_mesh():
+    _run_on_virtual_mesh("test_sharded_serving.py")

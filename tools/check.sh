@@ -3,7 +3,11 @@
 #
 #   1. import hygiene — every keto_tpu module imports (catches moved
 #      upstream APIs like the jax shard_map relocation at CI time)
-#   2. bench smoke — bench.py --smoke end-to-end (tiny config, short
+#   2. sharded serving parity — tests/test_sharded_serving.py on an
+#      8-way virtual CPU mesh: the edge-partitioned serving tier's
+#      allowed bitsets byte-identical to the single-chip engine and the
+#      host oracle, breaker fault absorption, incremental re-shard
+#   3. bench smoke — bench.py --smoke end-to-end (tiny config, short
 #      server leg): the serving path must boot, answer, and emit its
 #      summary JSON with exit 0. Includes the attribution-leak gate:
 #      the wall-clock accounting ledger (/debug/attribution) must cover
@@ -13,7 +17,7 @@
 #      BatchCheckEncoded leg must answer identically to the per-tuple
 #      string path on both transports (encoded_parity == ok) or bench
 #      exits 3
-#   3. chaos soak smoke — tools/soak.py: seeded deterministic fault
+#   4. chaos soak smoke — tools/soak.py: seeded deterministic fault
 #      schedule (crash/slow/nan + pool-phase drop/crash) under concurrent
 #      mixed load; answer parity, snaptoken monotonicity, no lost
 #      futures, bounded p99; plus the kill-and-restart drill (SIGKILL at
@@ -21,7 +25,7 @@
 #      oracle) and the device-fault drills (--device-chaos: OOM batch
 #      bisection parity, compile-failure quarantine, device-loss
 #      failover with bounded recovery)
-#   4. replication gate — 1 leader + 2 followers in-process: checkpoint
+#   5. replication gate — 1 leader + 2 followers in-process: checkpoint
 #      bootstrap + WAL-tail convergence under a lag bound, token-
 #      consistent reads on followers (wait AND bounce paths), read-only
 #      follower write plane, replication metrics exported; plus the
@@ -30,15 +34,15 @@
 #      (instance-labeled keto_cluster_* series) lints clean in both
 #      exposition formats, and a hedged check pair stitches into ONE
 #      cross-process trace on the leader's /debug/traces
-#   5. metrics lint — boot the serving stack (cluster federation on, so
+#   6. metrics lint — boot the serving stack (cluster federation on, so
 #      the self-federated keto_cluster_* series are linted too), drive
 #      traffic, scrape /metrics from both planes in Prometheus-text and
 #      OpenMetrics formats, and fail on naming/duplicate-series/format
 #      violations
-#   6. closure microbench gate — tools/closure_microbench.py --gate:
+#   7. closure microbench gate — tools/closure_microbench.py --gate:
 #      incremental closure update after one edge >= 5x faster than a
 #      full semiring rebuild (median-of-5 at m~2048)
-#   7. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
+#   8. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
 #
 # Usage: bash tools/check.sh            (from the repo root)
 set -o pipefail
@@ -54,6 +58,16 @@ echo "== encoded wire parity =="
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_wire_encoded.py -q -p no:cacheprovider \
   -k "parity or resync or stale" || exit 1
+
+echo "== sharded serving parity =="
+# the sharded serving tier on an 8-way virtual CPU mesh: allowed bitsets
+# must be byte-identical to the single-chip engine and the host oracle,
+# the breaker must absorb injected launch faults, and append-only writes
+# must re-shard incrementally
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  PALLAS_AXON_POOL_IPS= python -m pytest \
+  tests/test_sharded_serving.py -q -p no:cacheprovider || exit 1
 
 echo "== bench smoke =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python bench.py --smoke || exit 1
