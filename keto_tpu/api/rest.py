@@ -670,12 +670,20 @@ class ReadAPI:
 
 
 class WriteAPI:
-    def __init__(self, manager, snaptoken_fn, read_only: bool = False):
+    def __init__(
+        self, manager, snaptoken_fn, read_only=False, leader_hint_fn=None
+    ):
         self.manager = manager
         self.snaptoken_fn = snaptoken_fn
         # follower nodes serve this port (health/version/replication
-        # routes) but reject mutations — writes belong on the leader
+        # routes) but reject mutations — writes belong on the leader.
+        # A callable read_only is consulted per request: an elected node
+        # flips writable the moment it holds the lease, a fenced
+        # ex-leader flips read-only the moment it loses it.
         self.read_only = read_only
+        # () -> {"write_url", ...} | None: rejected writers learn where
+        # the leader lives from the 503 envelope instead of re-probing
+        self.leader_hint_fn = leader_hint_fn
 
     def register(self, app: web.Application) -> None:
         app.router.add_put(ROUTE_TUPLES, self.create_relation)
@@ -683,10 +691,17 @@ class WriteAPI:
         app.router.add_patch(ROUTE_TUPLES, self.patch_relations)
 
     def _reject_if_read_only(self) -> None:
-        if self.read_only:
+        ro = self.read_only() if callable(self.read_only) else self.read_only
+        if ro:
             from ..utils.errors import ErrReadOnlyFollower
 
-            raise ErrReadOnlyFollower()
+            hint = None
+            if self.leader_hint_fn is not None:
+                try:
+                    hint = self.leader_hint_fn()
+                except Exception:
+                    hint = None
+            raise ErrReadOnlyFollower(leader_hint=hint)
 
     async def create_relation(self, request: web.Request) -> web.Response:
         self._reject_if_read_only()
@@ -843,8 +858,10 @@ def build_write_app(
     manager, snaptoken_fn, version: str,
     cors: Optional[dict] = None, healthy_fn=None,
     logger=None, metrics=None,
-    read_only: bool = False, replication_source=None,
+    read_only=False, replication_source=None,
+    replication_source_fn=None,
     cluster_membership=None, replication_status_fn=None,
+    leader_hint_fn=None, directives_fn=None,
 ) -> web.Application:
     app = web.Application(
         middlewares=[
@@ -853,7 +870,10 @@ def build_write_app(
             error_middleware,
         ]
     )
-    WriteAPI(manager, snaptoken_fn, read_only=read_only).register(app)
+    WriteAPI(
+        manager, snaptoken_fn, read_only=read_only,
+        leader_hint_fn=leader_hint_fn,
+    ).register(app)
     register_common(app, version, healthy_fn, metrics)
     if replication_source is not None:
         # leader only: /replication/{status,checkpoint,wal} for followers.
@@ -861,6 +881,42 @@ def build_write_app(
         # operator-facing port, and replication traffic must not contend
         # with read-plane checks.
         replication_source.register(app)
+    elif replication_source_fn is not None:
+        # election-enabled follower: aiohttp routers freeze at startup,
+        # so the replication routes exist from day one but delegate per
+        # request — 503 (or the follower's lag view) until a promotion
+        # installs a PromotedReplicationSource, then serve for real
+        async def repl_status(request):
+            src = replication_source_fn()
+            if src is not None:
+                return await src.handle_status(request)
+            if replication_status_fn is not None:
+                return web.json_response(
+                    json.loads(
+                        json.dumps(replication_status_fn(), default=str)
+                    )
+                )
+            return web.json_response({"role": "follower"})
+
+        async def repl_checkpoint(request):
+            src = replication_source_fn()
+            if src is None:
+                return web.json_response(
+                    {"error": "not the replication leader"}, status=503
+                )
+            return await src.handle_checkpoint(request)
+
+        async def repl_wal(request):
+            src = replication_source_fn()
+            if src is None:
+                return web.json_response(
+                    {"error": "not the replication leader"}, status=503
+                )
+            return await src.handle_wal(request)
+
+        app.router.add_get("/replication/status", repl_status)
+        app.router.add_get("/replication/checkpoint", repl_checkpoint)
+        app.router.add_get("/replication/wal", repl_wal)
     elif replication_status_fn is not None:
         # follower: no WAL to serve, but the federation scraper still
         # wants a /replication/status on every member's write plane
@@ -872,7 +928,9 @@ def build_write_app(
         app.router.add_get("/replication/status", repl_status)
     if cluster_membership is not None:
         # leader: followers heartbeat here, over the same plane they
-        # already pull WAL from
+        # already pull WAL from. The reply doubles as the fleet control
+        # channel: QoS directives ride back on the heartbeat the
+        # follower was already sending.
         async def heartbeat(request):
             try:
                 payload = await request.json()
@@ -881,9 +939,13 @@ def build_write_app(
                 row = cluster_membership.upsert(payload)
             except Exception as e:
                 raise ErrMalformedInput(str(e))
-            return web.json_response(
-                {"ok": True, "heartbeats": row["heartbeats"]}
-            )
+            reply = {"ok": True, "heartbeats": row["heartbeats"]}
+            if directives_fn is not None:
+                try:
+                    reply["directives"] = directives_fn()
+                except Exception:
+                    pass
+            return web.json_response(reply)
 
         app.router.add_post("/cluster/heartbeat", heartbeat)
     return app

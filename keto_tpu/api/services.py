@@ -500,12 +500,18 @@ class WriteServicer:
         self.manager = manager
         self.snaptoken_fn = snaptoken_fn
         # follower nodes serve the write-plane PORT (health/version/
-        # replication) but reject mutations — writes belong on the leader
+        # replication) but reject mutations — writes belong on the leader.
+        # May be a callable: under leader election writability is dynamic
+        # (a promoted follower accepts, a fenced ex-leader rejects)
         self.read_only = read_only
+
+    def _is_read_only(self) -> bool:
+        ro = self.read_only
+        return bool(ro() if callable(ro) else ro)
 
     def TransactRelationTuples(self, request, context):
         try:
-            if self.read_only:
+            if self._is_read_only():
                 raise ErrReadOnlyFollower()
             inserts: list[RelationTuple] = []
             deletes: list[RelationTuple] = []
@@ -529,7 +535,7 @@ class WriteServicer:
 
     def DeleteRelationTuples(self, request, context):
         try:
-            if self.read_only:
+            if self._is_read_only():
                 raise ErrReadOnlyFollower()
             q = request.query
             query = query_from_proto_fields(

@@ -752,6 +752,22 @@ def status(ctx, block, timeout_s, cluster_view):
             f"alive, aggregate burn "
             f"{summary.get('aggregate_burn_rate', '?')})"
         )
+        election = summary.get("election")
+        if election:
+            expires = election.get("lease_expires_in_s")
+            click.echo(
+                f"election: term={election.get('observed_term', '?')} "
+                f"leader={election.get('leader_id') or '?'} "
+                f"lease_expires_in="
+                f"{expires if expires is not None else '?'}s "
+                f"transitions={election.get('transitions', '?')} "
+                f"last={election.get('last_transition') or '-'}"
+            )
+        if summary.get("degraded"):
+            click.echo(
+                "degraded: fleet QoS tightened "
+                f"(directives={summary.get('directives')})"
+            )
         for m in payload.get("members", []):
             lag = m.get("lag_versions")
             burn = m.get("burn_rate")

@@ -188,13 +188,18 @@ class Hedger:
 
 
 class EndpointRouter:
-    """Snaptoken-aware endpoint picking across a replicated read fleet.
+    """Health- and snaptoken-aware endpoint picking across a replicated
+    read fleet.
 
     Tracks, per endpoint, the newest store version it is KNOWN to have
     served (learned from successful at-least-token reads — a follower
     that answered a ``snaptoken=z7.x.y`` read has necessarily replayed
-    through version 7) plus a short cool-off after an error. ``pick``
-    returns a ``(primary, hedge)`` pair:
+    through version 7) plus a TIME-DECAYED error score: every failure
+    adds one point, and the score halves every ``cool_off_s`` seconds
+    (an endpoint with one transient failure is back in rotation after
+    one half-life; a flapping endpoint accumulates points and stays
+    benched exponentially longer — never permanently). ``pick`` returns
+    a ``(primary, hedge)`` pair:
 
     - the primary prefers an endpoint already at or past ``min_version``,
       so the server-side freshness wait is a no-op on the common path; a
@@ -204,8 +209,15 @@ class EndpointRouter:
       to the same replica would queue behind the same slowness, which is
       the failure hedging exists to escape.
 
-    All knowledge is client-observed: no extra control-plane RPCs, the
-    router converges from the traffic it routes.
+    Passive knowledge converges from routed traffic alone; feeding
+    ``observe_status`` a ``/cluster/status`` rollup sharpens it: members
+    rolled up red are demoted exactly like erroring endpoints, heartbeat
+    versions pre-warm the freshness map, and the leader's advertised
+    URLs (election lease or federation view) are remembered so the write
+    path can follow a leadership change. A term change never resets the
+    freshness map — store versions are preserved across promotion
+    (shared-WAL replay), so snaptoken routing stays valid through the
+    transition.
     """
 
     def __init__(
@@ -213,17 +225,37 @@ class EndpointRouter:
         endpoints: Sequence[str],
         cool_off_s: float = 1.0,
         clock: Callable[[], float] = time.monotonic,
+        *,
+        max_error_score: float = 16.0,
     ):
         eps = [str(e).rstrip("/") for e in endpoints if str(e).strip()]
         if not eps:
             raise ValueError("EndpointRouter needs at least one endpoint")
         self.endpoints = eps
-        self.cool_off_s = float(cool_off_s)
+        #: the error-score half-life; the name predates the decay
+        self.cool_off_s = max(1e-3, float(cool_off_s))
+        self.max_error_score = float(max_error_score)
         self._clock = clock
         self._known_version = {e: 0 for e in eps}
-        self._penalty_until = {e: 0.0 for e in eps}
+        self._error_score = {e: 0.0 for e in eps}
+        self._error_stamp = {e: 0.0 for e in eps}
+        self._health = {e: "green" for e in eps}
+        self._leader: Optional[dict] = None
+        self._term = 0
         self._rr = 0
         self._lock = threading.Lock()
+
+    def _decayed(self, endpoint: str, now: float) -> float:
+        score = self._error_score[endpoint]
+        if score <= 0.0:
+            return 0.0
+        dt = max(0.0, now - self._error_stamp[endpoint])
+        return score * 0.5 ** (dt / self.cool_off_s)
+
+    def _benched(self, endpoint: str, now: float) -> bool:
+        # one fresh error scores exactly 1.0 -> benched; after one
+        # half-life it is 0.5 -> back in rotation
+        return self._decayed(endpoint, now) >= 1.0
 
     def observe_version(self, endpoint: str, version: int) -> None:
         """Endpoint served a read at least as fresh as ``version``."""
@@ -234,13 +266,77 @@ class EndpointRouter:
                 self._known_version[endpoint] = int(version)
 
     def observe_error(self, endpoint: str) -> None:
-        """Endpoint failed a read: bench it for ``cool_off_s``."""
+        """Endpoint failed a read: add one point to its decaying error
+        score (repeat offenders stay benched longer; a single transient
+        failure decays away within ~one ``cool_off_s``)."""
         endpoint = str(endpoint).rstrip("/")
         with self._lock:
-            if endpoint in self._penalty_until:
-                self._penalty_until[endpoint] = (
-                    self._clock() + self.cool_off_s
-                )
+            if endpoint not in self._error_score:
+                return
+            now = self._clock()
+            self._error_score[endpoint] = min(
+                self.max_error_score, self._decayed(endpoint, now) + 1.0
+            )
+            self._error_stamp[endpoint] = now
+
+    def observe_status(self, status_doc: dict) -> None:
+        """Fold a ``/cluster/status`` rollup into the routing state:
+        red members are demoted, member versions pre-warm the freshness
+        map, and the current leader's URLs (member views or the election
+        block) are remembered for write-path follow-the-leader."""
+        if not isinstance(status_doc, dict):
+            return
+        cluster = status_doc.get("cluster") or {}
+        election = cluster.get("election") or {}
+        with self._lock:
+            term = int(election.get("observed_term") or 0)
+            if term > self._term:
+                self._term = term
+        for view in status_doc.get("members") or ():
+            if not isinstance(view, dict):
+                continue
+            read_url = str(view.get("read_url") or "").rstrip("/")
+            version = view.get("version")
+            if read_url and read_url in self._known_version:
+                with self._lock:
+                    health = str(view.get("health") or "green")
+                    self._health[read_url] = (
+                        health if view.get("alive", True) else "red"
+                    )
+                if version:
+                    self.observe_version(read_url, int(version))
+            if (view.get("role") or "") == "leader" and view.get(
+                "alive", True
+            ):
+                with self._lock:
+                    self._leader = {
+                        "read_url": read_url,
+                        "write_url": str(
+                            view.get("write_url") or ""
+                        ).rstrip("/"),
+                        "term": self._term,
+                    }
+
+    def observe_leader(self, hint: dict) -> None:
+        """A 503 envelope's ``leader_hint`` (or an election lease) names
+        the current leader directly — trust it over older fleet views."""
+        if not isinstance(hint, dict):
+            return
+        with self._lock:
+            term = int(hint.get("term") or 0)
+            if term and term < self._term:
+                return  # stale hint from a fenced ex-leader
+            self._term = max(self._term, term)
+            self._leader = {
+                "read_url": str(hint.get("read_url") or "").rstrip("/"),
+                "write_url": str(hint.get("write_url") or "").rstrip("/"),
+                "term": self._term,
+            }
+
+    def leader(self) -> Optional[dict]:
+        """The newest known leader coordinates (or None)."""
+        with self._lock:
+            return dict(self._leader) if self._leader else None
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -248,7 +344,9 @@ class EndpointRouter:
             return {
                 e: {
                     "known_version": self._known_version[e],
-                    "benched": self._penalty_until[e] > now,
+                    "benched": self._benched(e, now),
+                    "error_score": round(self._decayed(e, now), 3),
+                    "health": self._health[e],
                 }
                 for e in self.endpoints
             }
@@ -257,8 +355,13 @@ class EndpointRouter:
         with self._lock:
             now = self._clock()
             healthy = [
-                e for e in self.endpoints if self._penalty_until[e] <= now
-            ] or list(self.endpoints)  # everything benched: route anyway
+                e
+                for e in self.endpoints
+                if not self._benched(e, now) and self._health[e] != "red"
+            ] or [
+                # everything red/benched: fall back to the least-bad set
+                e for e in self.endpoints if not self._benched(e, now)
+            ] or list(self.endpoints)  # route anyway — reads never stop
             pool = healthy
             if min_version > 0:
                 fresh = [

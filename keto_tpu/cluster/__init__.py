@@ -9,7 +9,14 @@ telemetry/federation.py scrapes each member's ``/metrics`` +
 plus the ``/cluster/status`` health rollup.
 """
 
+from .election import ElectionManager, LeaseStore, PromotedReplicationSource
 from .heartbeat import ClusterHeartbeater
 from .membership import ClusterMembership
 
-__all__ = ["ClusterHeartbeater", "ClusterMembership"]
+__all__ = [
+    "ClusterHeartbeater",
+    "ClusterMembership",
+    "ElectionManager",
+    "LeaseStore",
+    "PromotedReplicationSource",
+]

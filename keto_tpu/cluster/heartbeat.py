@@ -10,6 +10,12 @@ can heartbeat — no extra discovery surface.
 Failures are swallowed and counted: the heartbeater must never take a
 serving node down because the leader is restarting. ``status()`` exposes
 beat/error counts and the last error for ``/cluster``-side debugging.
+
+The heartbeat REPLY is the fleet's control channel: the leader embeds
+``directives`` (today: a fleet-wide QoS scale, tightened while the
+aggregate SLO burn alert is firing) in the response body, and
+``on_directives`` applies them locally — so degradation propagates to
+every member at heartbeat cadence with no extra RPC surface.
 """
 
 from __future__ import annotations
@@ -30,6 +36,7 @@ class ClusterHeartbeater:
         timeout_s: float = 5.0,
         logger=None,
         post_fn=None,  # injectable for tests: post_fn(url, payload_dict)
+        on_directives=None,  # on_directives(dict) applies a leader order
     ):
         self.upstream = upstream.rstrip("/")
         self.url = f"{self.upstream}/cluster/heartbeat"
@@ -38,6 +45,8 @@ class ClusterHeartbeater:
         self.timeout_s = float(timeout_s)
         self._logger = logger
         self._post_fn = post_fn or self._post
+        self._on_directives = on_directives
+        self.last_directives = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.beats = 0
@@ -45,21 +54,25 @@ class ClusterHeartbeater:
         self.last_error: Optional[str] = None
         self.last_beat_t: Optional[float] = None
 
-    def _post(self, url: str, payload: dict) -> None:
+    def _post(self, url: str, payload: dict):
         req = urllib.request.Request(
             url,
             data=json.dumps(payload).encode("utf-8"),
             method="POST",
             headers={"Content-Type": "application/json"},
         )
-        urllib.request.urlopen(req, timeout=self.timeout_s).read()
+        body = urllib.request.urlopen(req, timeout=self.timeout_s).read()
+        try:
+            return json.loads(body.decode("utf-8"))
+        except Exception:
+            return None
 
     def beat_once(self) -> bool:
         """One heartbeat attempt; True on success. Used by the loop and
         directly by tests."""
         try:
             payload = self._payload_fn()
-            self._post_fn(self.url, payload)
+            reply = self._post_fn(self.url, payload)
         except Exception as e:
             self.errors += 1
             self.last_error = f"{type(e).__name__}: {e}"
@@ -76,6 +89,18 @@ class ClusterHeartbeater:
             return False
         self.beats += 1
         self.last_beat_t = time.time()
+        if isinstance(reply, dict):
+            directives = reply.get("directives")
+            if isinstance(directives, dict):
+                self.last_directives = directives
+                if self._on_directives is not None:
+                    try:
+                        self._on_directives(directives)
+                    except Exception as e:
+                        self.last_error = (
+                            f"directive apply failed: "
+                            f"{type(e).__name__}: {e}"
+                        )
         return True
 
     def _run(self) -> None:
@@ -107,5 +132,6 @@ class ClusterHeartbeater:
             "errors": self.errors,
             "last_error": self.last_error,
             "last_beat_t": self.last_beat_t,
+            "last_directives": self.last_directives,
             "running": self._thread is not None,
         }

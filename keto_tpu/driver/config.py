@@ -527,6 +527,30 @@ CONFIG_SCHEMA = {
                     },
                     "additionalProperties": False,
                 },
+                # lease-based leader election over the shared WAL
+                # directory (cluster/election.py): fencing-token leases,
+                # automated follower promotion, write-plane fencing
+                "election": {
+                    "type": "object",
+                    "properties": {
+                        "enabled": {"type": "boolean"},
+                        # how long a lease lives without renewal; failover
+                        # completes within roughly one TTL
+                        "lease_ttl_s": {"type": "number", "minimum": 0.1},
+                        # leader renews / followers observe at this cadence;
+                        # should be well under lease_ttl_s
+                        "heartbeat_interval_ms": {
+                            "type": "number", "minimum": 10
+                        },
+                        # higher-priority candidates campaign first
+                        # (stagger = candidacy rank x heartbeat interval)
+                        "priority": {"type": "integer"},
+                        # lease/lineage directory; defaults to the
+                        # store.wal.dir all members share
+                        "wal_dir": {"type": "string"},
+                    },
+                    "additionalProperties": False,
+                },
             },
             "additionalProperties": False,
         },
@@ -639,6 +663,11 @@ DEFAULTS = {
     "cluster.health.staleness_red_s": 60.0,
     "cluster.health.burn_yellow": 1.0,
     "cluster.health.burn_red": 2.0,
+    "cluster.election.enabled": False,
+    "cluster.election.lease_ttl_s": 3.0,
+    "cluster.election.heartbeat_interval_ms": 500,
+    "cluster.election.priority": 0,
+    "cluster.election.wal_dir": "",
 }
 
 

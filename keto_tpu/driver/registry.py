@@ -339,6 +339,10 @@ class Registry:
         self._cluster_membership = None
         self._cluster_heartbeater = None
         self._federation = None
+        # lease-based leader election (cluster/election.py) and the
+        # replication feed a promoted follower starts serving
+        self._election = None
+        self._promoted_source = None
         self._cluster_instance_id = ""
         self._bound_read_port = 0
         self._bound_write_port = 0
@@ -1385,6 +1389,23 @@ class Registry:
             lag = rep.lag()
             payload["lag_versions"] = lag.get("lag_versions")
             payload["staleness_seconds"] = lag.get("staleness_seconds")
+        em = self._election
+        if em is not None:
+            # election-aware role: a promoted follower advertises itself
+            # as the leader so routers and the fleet view follow it
+            payload["role"] = em.role
+            payload["election"] = {
+                "priority": em.priority,
+                "position": store.version,
+                "term": em.term,
+            }
+        elif self.election_enabled():
+            payload["election"] = {
+                "priority": int(
+                    self.config.get("cluster.election.priority", default=0)
+                ),
+                "position": store.version,
+            }
         return payload
 
     def cluster_membership(self):
@@ -1440,6 +1461,12 @@ class Registry:
                     )
                 ),
                 self_payload_fn=self._cluster_self_payload,
+                election_status_fn=(
+                    (lambda: self.election().status())
+                    if self.election_enabled()
+                    else None
+                ),
+                qos=self.qos(),
                 logger=self.logger(),
             )
         return self._federation
@@ -1467,8 +1494,132 @@ class Registry:
                     )
                     / 1e3,
                     logger=self.logger(),
+                    on_directives=self._apply_directives,
                 )
         return self._cluster_heartbeater
+
+    # -- leader election -------------------------------------------------------
+
+    def election_enabled(self) -> bool:
+        return self.cluster_enabled() and bool(
+            self.config.get("cluster.election.enabled", default=False)
+        )
+
+    def _election_wal_dir(self) -> str:
+        """The shared directory leases and the fencing-token lineage live
+        in — by default the WAL directory every member already shares."""
+        d = str(self.config.get("cluster.election.wal_dir", default="") or "")
+        if not d:
+            d = str(self.config.get("store.wal.dir", default="") or "")
+        return d
+
+    def election(self):
+        """Lease-based leader election over the shared WAL directory.
+        None unless cluster.enabled AND cluster.election.enabled. Built
+        lazily so the advertised URLs reflect the BOUND ports — callers
+        on the serve path must defer through a lambda, not capture the
+        manager at plane-build time."""
+        if self._election is None and self.election_enabled():
+            wal_dir = self._election_wal_dir()
+            if not wal_dir:
+                raise ErrMalformedInput(
+                    "cluster.election.enabled requires a shared WAL "
+                    "directory (store.wal.dir or cluster.election.wal_dir)"
+                )
+            from ..cluster import ElectionManager, LeaseStore
+
+            self._election = ElectionManager(
+                LeaseStore(wal_dir),
+                instance_id=self.cluster_instance_id(),
+                lease_ttl_s=float(
+                    self.config.get(
+                        "cluster.election.lease_ttl_s", default=3.0
+                    )
+                ),
+                heartbeat_interval_s=float(
+                    self.config.get(
+                        "cluster.election.heartbeat_interval_ms",
+                        default=500,
+                    )
+                )
+                / 1e3,
+                priority=int(
+                    self.config.get("cluster.election.priority", default=0)
+                ),
+                read_url=self._cluster_url("read"),
+                write_url=self._cluster_url("write"),
+                promote_fn=self._election_promote,
+                retarget_fn=self._election_retarget,
+                position_fn=lambda: self.store().version,
+                metrics=self.metrics(),
+                logger=self.logger(),
+            )
+        return self._election
+
+    def _election_promote(self) -> None:
+        """Winning-candidate hook: replay the shared WAL into the local
+        store (zero acked-write loss — every acked write hit the WAL
+        before its ack), then start serving the replication feed so the
+        remaining followers can retarget here without re-bootstrapping."""
+        wal_dir = self._election_wal_dir()
+        rep = self.replicator()
+        if rep is not None:
+            result = rep.promote(wal_dir)
+            self.logger().info("promoted via election", **result)
+        if self._promoted_source is None:
+            from ..cluster import PromotedReplicationSource
+
+            src = PromotedReplicationSource(
+                self.store(),
+                wal_dir,
+                sync=str(
+                    self.config.get("store.wal.sync", default="always")
+                ),
+            )
+            src.open()
+            self._promoted_source = src
+
+    def _election_retarget(self, lease: dict) -> None:
+        """Losing-candidate / follower hook: tail the new leader's feed.
+        The cursor carries over — same shared WAL directory — so no
+        checkpoint re-bootstrap."""
+        target = str(lease.get("write_url") or "")
+        if not target:
+            return
+        rep = self._replicator
+        if rep is not None:
+            rep.retarget(target)
+        hb = self._cluster_heartbeater
+        if hb is not None:
+            hb.upstream = target.rstrip("/")
+            hb.url = f"{hb.upstream}/cluster/heartbeat"
+
+    def _write_read_only(self) -> bool:
+        """Dynamic write gate under election: only the holder of a live,
+        unfenced lease accepts mutations — a promoted follower opens up,
+        a fenced ex-leader slams shut mid-flight."""
+        em = self._election
+        if em is not None:
+            return not em.is_writable()
+        return self.replication_role() == "follower"
+
+    def _apply_directives(self, directives: dict) -> None:
+        """Follower side of the heartbeat control channel: the leader's
+        reply carries fleet directives (QoS degradation scale while the
+        aggregate burn alert fires)."""
+        qos = self.qos()
+        if qos is None:
+            return
+        scale = directives.get("qos_scale")
+        if scale is not None:
+            qos.set_scale(
+                float(scale),
+                reason=str(directives.get("reason") or ""),
+            )
+
+    def _federation_directives(self):
+        fed = self._federation
+        return fed.directives() if fed is not None else None
 
     def qos(self):
         """Per-tenant token-bucket admission (engine/qos.py), handed to
@@ -1544,6 +1695,25 @@ class Registry:
             )
         return self._check_executor
 
+    def _cluster_status_fn(self):
+        """/cluster/status provider for the read plane: the federation
+        rollup where one runs (leader/standalone); on election-enabled
+        followers a minimal election-only view, so routers and operators
+        can still see the term and leader coordinates from any member."""
+        fed = self.federation()
+        if fed is not None:
+            return fed.status
+        if not self.election_enabled():
+            return None
+
+        def status() -> dict:
+            return {
+                "cluster": {"election": self.election().status()},
+                "members": [],
+            }
+
+        return status
+
     def read_plane(self) -> PlaneServer:
         if self._read_plane is None:
             grpc_server = build_read_grpc_server(
@@ -1580,11 +1750,7 @@ class Registry:
                 debug=self.debug_context(),
                 version_waiter=self.version_waiter(),
                 max_freshness_wait_s=self._freshness_cap_s,
-                cluster_status_fn=(
-                    self.federation().status
-                    if self.federation() is not None
-                    else None
-                ),
+                cluster_status_fn=self._cluster_status_fn(),
                 encoded_front=self.encoded_front(),
             )
             self._read_plane = PlaneServer(
@@ -1628,7 +1794,11 @@ class Registry:
                 max_message_bytes=int(
                     self.config.get("serve.write.grpc-max-message-size")
                 ),
-                read_only=self.replication_role() == "follower",
+                read_only=(
+                    self._write_read_only
+                    if self.election_enabled()
+                    else self.replication_role() == "follower"
+                ),
             )
             app = build_write_app(
                 self.store(),
@@ -1638,12 +1808,35 @@ class Registry:
                 healthy_fn=self.health.is_serving,
                 logger=self.logger(),
                 metrics=self.metrics(),
-                read_only=self.replication_role() == "follower",
+                read_only=(
+                    self._write_read_only
+                    if self.election_enabled()
+                    else self.replication_role() == "follower"
+                ),
                 replication_source=self.replication_source(),
+                # election-enabled followers may be promoted after the
+                # router froze: register deferred /replication/* routes
+                # that come alive the moment a promoted source exists
+                replication_source_fn=(
+                    (lambda: self._promoted_source)
+                    if self.election_enabled()
+                    and self.replication_role() == "follower"
+                    else None
+                ),
                 cluster_membership=self.cluster_membership(),
                 replication_status_fn=(
                     self.replicator().lag
                     if self.replicator() is not None
+                    else None
+                ),
+                leader_hint_fn=(
+                    (lambda: self.election().leader_hint())
+                    if self.election_enabled()
+                    else None
+                ),
+                directives_fn=(
+                    self._federation_directives
+                    if self.cluster_enabled()
                     else None
                 ),
             )
@@ -1912,11 +2105,19 @@ class Registry:
             fed = self.federation()
             if fed is not None:
                 fed.start()
+            em = self.election() if self.election_enabled() else None
+            if em is not None:
+                if self.replication_role() in ("", "leader"):
+                    # the configured leader claims the bootstrap lease
+                    # (term 1) before followers can start campaigning
+                    em.ensure_leadership()
+                em.start()
             log.info(
                 "cluster plane started",
                 instance_id=self.cluster_instance_id(),
                 role=self.replication_role() or "leader",
                 federation=fed is not None,
+                election=em is not None,
             )
         self._start_config_watcher()
         if bool(
@@ -2103,7 +2304,15 @@ class Registry:
         # flip readiness first so load balancers stop routing here
         self.health.set_serving(False)
         # cluster plane next: stop advertising/scraping a node that is
-        # about to lose its serving surfaces
+        # about to lose its serving surfaces. A clean shutdown releases
+        # the lease so the survivors fail over in one heartbeat instead
+        # of waiting out the TTL
+        if self._election is not None:
+            em = self._election
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: em.stop(release=True)
+            )
+            self._election = None
         if self._federation is not None:
             await asyncio.get_running_loop().run_in_executor(
                 None, self._federation.stop
@@ -2145,6 +2354,13 @@ class Registry:
             # backend once the dispatch loops are drained
             self._device_supervisor.stop()
             self._device_supervisor = None
+        if self._promoted_source is not None:
+            # after the write plane: the last acked mutation has already
+            # run its delta listener, so the adopted WAL is complete
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._promoted_source.close
+            )
+            self._promoted_source = None
         if self._replicator is not None:
             await asyncio.get_running_loop().run_in_executor(
                 None, self._replicator.stop

@@ -84,6 +84,11 @@ class NamespaceQos:
         self._lock = threading.Lock()
         self._buckets: dict[str, _Bucket] = {}
         self._throttled_counts: dict[str, int] = {}
+        # fleet-degradation scale (cluster burn alert): every bucket's
+        # effective rate/burst is multiplied by this, so the leader can
+        # tighten admission fleet-wide and relax it on recovery
+        self._scale = 1.0
+        self._scale_reason = ""
         self._throttled = None
         if metrics is not None:
             self._throttled = metrics.counter(
@@ -91,9 +96,32 @@ class NamespaceQos:
                 "check admissions rejected by per-namespace QoS",
                 labelnames=("namespace",),
             )
+            metrics.gauge(
+                "keto_qos_fleet_scale",
+                "fleet QoS scale applied to every bucket (1.0 normal, "
+                "<1 while the aggregate burn alert is degrading)",
+                fn=lambda: self._scale,
+            )
+
+    def set_scale(self, scale: float, reason: str = "") -> bool:
+        """Apply a fleet-wide degradation scale in (0, 1]. Existing
+        buckets rebuild lazily on their next admit (the rate/burst
+        mismatch check below). Returns True when the scale changed."""
+        scale = min(1.0, max(0.01, float(scale)))
+        with self._lock:
+            if scale == self._scale:
+                return False
+            self._scale = scale
+            self._scale_reason = str(reason)
+        return True
 
     def _limits(self, namespace: str) -> tuple[float, float]:
-        return self.overrides.get(namespace, (self.rate, self.burst))
+        rate, burst = self.overrides.get(namespace, (self.rate, self.burst))
+        scale = self._scale
+        if scale != 1.0 and rate > 0:
+            rate = rate * scale
+            burst = max(1.0, burst * scale)
+        return rate, burst
 
     def admit(self, namespace: str, n: int = 1) -> None:
         """Debit ``n`` check rows from ``namespace``'s bucket; raises
@@ -133,6 +161,8 @@ class NamespaceQos:
             return {
                 "rate": self.rate,
                 "burst": self.burst,
+                "scale": self._scale,
+                "scale_reason": self._scale_reason,
                 "overrides": {
                     ns: {"rate": r, "burst": b}
                     for ns, (r, b) in self.overrides.items()

@@ -78,6 +78,14 @@ site                      effect when armed
                           mesh dispatch; the breaker must answer the batch
                           from the host oracle and re-probe the mesh path
                           (parallel/serving.py + engine/fallback.py)
+``election.split_heartbeat``  a follower loses one leader-liveness
+                          observation and falsely suspects a live leader —
+                          the premature candidacy must lose the lease CAS,
+                          never mint a second term (cluster/election.py)
+``replica.promote_fail``  a winning candidate's ``promote(wal_dir)`` raises
+                          mid-failover; the lease must be released and the
+                          election re-run instead of wedging the fleet
+                          read-only (cluster/election.py)
 ========================  ====================================================
 
 Slowness sites (armed with :meth:`FaultRegistry.arm_slow`, consumed with
@@ -107,6 +115,11 @@ site                      seam that honors it when armed
                           mesh dispatch — models a straggling shard, the
                           deadline plane's cross-mesh seam
                           (parallel/serving.py)
+``election.lease_stall``  a lease acquire/renew stalls before its critical
+                          section — a stalled renewal lets a live leader's
+                          lease expire (it must detect the fencing and step
+                          down); a stalled candidate loses its race
+                          (cluster/election.py)
 ========================  ====================================================
 
 ``KETO_FAULTS`` syntax: comma-separated entries, each one of

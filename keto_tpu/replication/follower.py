@@ -359,6 +359,34 @@ class FollowerReplicator:
                     )
                 self._cv.wait(min(remaining, 0.25))
 
+    # -- retargeting ----------------------------------------------------------
+
+    def retarget(self, upstream: str) -> None:
+        """Repoint the tail at a new leader (the election loser's path):
+        stop the tail thread, swap the upstream, resume from the SAME
+        cursor. No re-bootstrap — the promoted leader serves the same
+        shared WAL directory the old one did, so ``(segment, offset)``
+        positions carry over verbatim."""
+        upstream = upstream.rstrip("/")
+        if not upstream or upstream == self.upstream or self.role == "leader":
+            return
+        was_running = self._thread is not None
+        if was_running:
+            self.stop()
+        old = self.upstream
+        self.upstream = upstream
+        self.last_error = None
+        self._last_contact = self._clock()  # staleness clock restarts
+        log.info("replication retargeted: %s -> %s", old, upstream)
+        if was_running:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._tail_loop,
+                name="keto-replication-tail",
+                daemon=True,
+            )
+            self._thread.start()
+
     # -- promotion ------------------------------------------------------------
 
     def promote(self, wal_dir: str) -> dict:

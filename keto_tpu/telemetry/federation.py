@@ -130,6 +130,10 @@ class FederationScraper:
         objective: float = 0.999,
         alert_burn_rate: Optional[float] = None,
         self_payload_fn: Optional[Callable[[], dict]] = None,
+        election_status_fn: Optional[Callable[[], dict]] = None,
+        qos=None,  # NamespaceQos to tighten fleet-wide while degraded
+        degrade_scale: float = 0.25,
+        recovery_fraction: float = 0.5,
         logger=None,
         fetch_fn=None,  # fetch_fn(url, timeout_s) -> text; tests inject
         clock: Callable[[], float] = time.monotonic,
@@ -150,6 +154,17 @@ class FederationScraper:
             else self.thresholds["burn_red"]
         )
         self._self_payload_fn = self_payload_fn
+        self._election_status_fn = election_status_fn
+        self._qos = qos
+        # fleet degradation state machine: tighten QoS when the
+        # aggregate burn crosses the alert line, relax only once it
+        # falls below recovery_fraction * alert (hysteresis, so a burn
+        # hovering at the line does not flap the fleet's admission)
+        self.degrade_scale = min(1.0, max(0.01, float(degrade_scale)))
+        self.recovery_fraction = min(1.0, max(0.0, float(recovery_fraction)))
+        self.degraded = False
+        self.degraded_since: Optional[float] = None
+        self.degradations = 0
         self._logger = logger
         self._fetch = fetch_fn or _default_fetch
         self._clock = clock
@@ -222,6 +237,12 @@ class FederationScraper:
             "wall time of the last federation scrape cycle (runs on its "
             "own thread, off the serving path)",
         )
+        self._g_degraded = g(
+            "keto_cluster_degraded",
+            "1 while the aggregate burn alert has the fleet's QoS "
+            "tightened, else 0",
+            fn=lambda: 1.0 if self.degraded else 0.0,
+        )
 
     # -- one scrape cycle -----------------------------------------------------
 
@@ -242,6 +263,7 @@ class FederationScraper:
             ),
             "read_url": row.get("read_url"),
             "write_url": row.get("write_url"),
+            "election": row.get("election"),
             "lag_versions": None,
             "lag_seconds": None,
             "staleness_seconds": None,
@@ -411,6 +433,7 @@ class FederationScraper:
                         )
                     except Exception:
                         pass
+        self._update_degradation(aggregate_burn)
         self.cycles += 1
         self.last_cycle_ms = round((time.monotonic() - t0) * 1000, 3)
         self._g_cycle_ms.set(self.last_cycle_ms)
@@ -426,6 +449,9 @@ class FederationScraper:
                 "objective": self.objective,
                 "alert_burn_rate": self.alert_burn_rate,
                 "alerts_fired": self.alerts_fired,
+                "degraded": self.degraded,
+                "degradations": self.degradations,
+                "directives": self.directives(),
                 "scrape": {
                     "cycles": self.cycles,
                     "errors": self.scrape_errors,
@@ -436,9 +462,64 @@ class FederationScraper:
             },
             "members": views,
         }
+        if self._election_status_fn is not None:
+            try:
+                status["cluster"]["election"] = self._election_status_fn()
+            except Exception:
+                pass
         with self._lock:
             self._last_status = status
         return status
+
+    def _update_degradation(self, aggregate_burn: float) -> None:
+        """Flip the fleet degradation state with hysteresis and apply it
+        locally; followers pick the same directive up from their next
+        heartbeat reply."""
+        if not self.degraded and aggregate_burn >= self.alert_burn_rate:
+            self.degraded = True
+            self.degraded_since = self._clock()
+            self.degradations += 1
+            if self._logger is not None:
+                try:
+                    self._logger.warning(
+                        "cluster_qos_degraded",
+                        aggregate_burn_rate=round(aggregate_burn, 2),
+                        qos_scale=self.degrade_scale,
+                    )
+                except Exception:
+                    pass
+        elif self.degraded and aggregate_burn <= (
+            self.alert_burn_rate * self.recovery_fraction
+        ):
+            self.degraded = False
+            self.degraded_since = None
+            if self._logger is not None:
+                try:
+                    self._logger.info(
+                        "cluster_qos_recovered",
+                        aggregate_burn_rate=round(aggregate_burn, 2),
+                    )
+                except Exception:
+                    pass
+        if self._qos is not None:
+            self._qos.set_scale(
+                self.degrade_scale if self.degraded else 1.0,
+                reason=(
+                    "cluster aggregate burn alert"
+                    if self.degraded
+                    else ""
+                ),
+            )
+
+    def directives(self) -> dict:
+        """The fleet order embedded in every heartbeat reply."""
+        return {
+            "qos_scale": self.degrade_scale if self.degraded else 1.0,
+            "degraded": self.degraded,
+            "reason": (
+                "cluster aggregate burn alert" if self.degraded else ""
+            ),
+        }
 
     # -- surfaces -------------------------------------------------------------
 

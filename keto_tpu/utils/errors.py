@@ -134,10 +134,29 @@ class ErrFollowerLag(ErrUnavailable):
 
 class ErrReadOnlyFollower(ErrUnavailable):
     """A mutation reached a follower replica. Followers serve the read
-    plane only — the client must write to the leader endpoint."""
+    plane only — the client must write to the leader endpoint. When the
+    node knows who leads (election lease on file), the envelope carries a
+    ``leader_hint`` so the client can follow the leader without an extra
+    discovery round-trip."""
+
+    def __init__(
+        self,
+        message: str | None = None,
+        *,
+        leader_hint: dict | None = None,
+    ):
+        #: {"leader_id", "term", "read_url", "write_url"} or None
+        self.leader_hint = leader_hint
+        super().__init__(message)
 
     def default_message(self) -> str:
         return "This replica is a read-only follower; write to the leader."
+
+    def envelope(self) -> dict:
+        doc = super().envelope()
+        if self.leader_hint:
+            doc["error"]["details"] = {"leader_hint": self.leader_hint}
+        return doc
 
 
 class ErrVocabEpochMismatch(KetoError):

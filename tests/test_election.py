@@ -1,0 +1,325 @@
+"""Lease-based leader election: fencing, clock skew, failed promotions.
+
+The safety property under test is the one the game-day drill relies on:
+at most one node passes the write-path fence at any instant, across the
+whole double-leader window — the interval where a stale ex-leader still
+*believes* it leads (its own clock says the lease is live) while a newer
+term already exists on disk. Terms are compared before expiry, so no
+clock skew lets a fenced leader write.
+"""
+
+import json
+import os
+
+import pytest
+
+from keto_tpu.cluster.election import (
+    LEASE_FILE,
+    ElectionManager,
+    LeaseStore,
+)
+from keto_tpu.faults import FAULTS
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def manager(store, instance_id, clock, **kw):
+    kw.setdefault("lease_ttl_s", 3.0)
+    kw.setdefault("heartbeat_interval_s", 0.01)
+    return ElectionManager(
+        store, instance_id=instance_id, clock=clock, **kw
+    )
+
+
+class TestLeaseStore:
+    def test_vacant_acquire_mints_term_one(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        lease = store.acquire("a", 3.0, write_url="http://a:1")
+        assert lease is not None
+        assert lease["term"] == 1
+        assert lease["leader_id"] == "a"
+        assert store.fence_check("a", 1)
+        lineage = store.lineage()
+        assert [r["term"] for r in lineage] == [1]
+        assert lineage[0]["prev_leader_id"] is None
+
+    def test_live_lease_blocks_other_candidates(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        assert store.acquire("a", 3.0) is not None
+        assert store.acquire("b", 3.0) is None
+        # ...until it expires
+        clock.advance(3.5)
+        lease = store.acquire("b", 3.0)
+        assert lease is not None and lease["term"] == 2
+
+    def test_renew_extends_and_fences(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        store.acquire("a", 3.0)
+        clock.advance(2.0)
+        renewed = store.renew("a", 1, 3.0)
+        assert renewed is not None
+        assert renewed["expires_at"] == pytest.approx(clock() + 3.0)
+        # a newer term on disk fences the old leader's renewal
+        clock.advance(3.5)
+        store.acquire("b", 3.0)
+        assert store.renew("a", 1, 3.0) is None
+
+    def test_release_expires_immediately(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        store.acquire("a", 300.0)
+        assert store.release("a", 1)
+        assert not store.fence_check("a", 1)
+        # a successor need not wait out the 300s TTL
+        lease = store.acquire("b", 3.0)
+        assert lease is not None and lease["term"] == 2
+        # releasing with a stale term is a no-op
+        assert not store.release("a", 1)
+
+    def test_corrupt_lease_reads_as_vacant(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        store.acquire("a", 3.0)
+        with open(os.path.join(str(tmp_path), LEASE_FILE), "w") as f:
+            f.write("{half a lease")
+        assert store.read() is None
+        # vacancy only ever delays an election; the next acquire wins —
+        # note the lineage keeps its chain even across the corruption
+        lease = store.acquire("b", 3.0)
+        assert lease is not None and lease["term"] == 1
+
+    def test_lineage_is_strictly_increasing(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        for i, who in enumerate(["a", "b", "a", "c"]):
+            clock.advance(10.0)
+            lease = store.acquire(who, 3.0)
+            assert lease is not None and lease["term"] == i + 1
+        terms = [r["term"] for r in store.lineage()]
+        assert terms == [1, 2, 3, 4]
+        chain = [r["prev_term"] for r in store.lineage()]
+        assert chain == [0, 1, 2, 3]
+
+
+class TestClockSkewFencing:
+    """Two LeaseStores with different clocks over one directory: the
+    double-leader window, driven explicitly."""
+
+    def test_stale_ex_leader_is_fenced_despite_skew(self, tmp_path):
+        # A's clock runs 20s behind B's: by A's reckoning its lease is
+        # comfortably live for the whole test
+        clock_a = FakeClock(1_000.0)
+        clock_b = FakeClock(1_020.0)
+        store_a = LeaseStore(str(tmp_path), clock=clock_a)
+        store_b = LeaseStore(str(tmp_path), clock=clock_b)
+
+        lease = store_a.acquire("a", 10.0)
+        assert lease is not None and lease["term"] == 1
+        # double-leader window opens: B (whose clock says the lease
+        # expired 10s ago) takes over with term 2...
+        takeover = store_b.acquire("b", 10.0)
+        assert takeover is not None and takeover["term"] == 2
+        # ...while A's clock still believes term 1 has ~10s to live.
+        # The fence compares terms BEFORE expiry, so A is rejected:
+        assert clock_a() < lease["expires_at"]
+        assert not store_a.fence_check("a", 1)
+        assert store_b.fence_check("b", 2)
+
+    def test_exactly_one_writer_throughout_the_window(self, tmp_path):
+        clock_a = FakeClock(1_000.0)
+        clock_b = FakeClock(1_020.0)
+        store_a = LeaseStore(str(tmp_path), clock=clock_a)
+        store_b = LeaseStore(str(tmp_path), clock=clock_b)
+        store_a.acquire("a", 10.0)
+        # before the takeover: A alone passes the fence
+        assert store_a.fence_check("a", 1)
+        assert not store_b.fence_check("b", 1)
+        store_b.acquire("b", 10.0)
+        # after: B alone passes — at no instant did both
+        assert not store_a.fence_check("a", 1)
+        assert store_b.fence_check("b", 2)
+
+    def test_manager_write_gate_rejects_late_writes(self, tmp_path):
+        """The ElectionManager integration of the same property: a
+        leader whose lease was taken over answers is_writable()=False
+        on the very next mutation, no cached verdicts."""
+        clock_a = FakeClock(1_000.0)
+        clock_b = FakeClock(1_020.0)
+        store_a = LeaseStore(str(tmp_path), clock=clock_a)
+        store_b = LeaseStore(str(tmp_path), clock=clock_b)
+        em = manager(store_a, "a", clock_a, write_url="http://a:1")
+        assert em.ensure_leadership()
+        assert em.is_writable()
+        store_b.acquire("b", 10.0, write_url="http://b:1")
+        # the stale ex-leader's gate slams shut instantly
+        assert not em.is_writable()
+        # and the rejection carries the new leader's coordinates
+        hint = em.leader_hint()
+        assert hint == {
+            "leader_id": "b",
+            "term": 2,
+            "read_url": "",
+            "write_url": "http://b:1",
+        }
+
+
+class TestElectionManager:
+    def test_campaign_wins_vacant_lease_and_promotes(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        promoted = []
+        em = manager(
+            store, "b", clock,
+            promote_fn=lambda: promoted.append(True) or {"applied": 0},
+        )
+        em.run_once()
+        assert em.role == "leader"
+        assert em.term == 1
+        assert promoted == [True]
+        assert em.is_writable()
+        assert em.leader_hint() is None
+
+    def test_fenced_leader_steps_down_and_retargets(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        retargets = []
+        em = manager(
+            store, "a", clock,
+            write_url="http://a:1",
+            retarget_fn=retargets.append,
+        )
+        assert em.ensure_leadership()
+        # a rival takes over (e.g. after a lease_stall let the TTL lapse)
+        clock.advance(10.0)
+        store.acquire("b", 3.0, write_url="http://b:1")
+        em.run_once()
+        assert em.role == "follower"
+        assert em.term == 0
+        assert "fenced by b" in em.last_transition["reason"]
+        assert [r["write_url"] for r in retargets] == ["http://b:1"]
+
+    def test_failed_promotion_releases_and_reelects(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        promoted = []
+        em = manager(
+            store, "b", clock,
+            promote_fn=lambda: promoted.append(True) or {},
+        )
+        FAULTS.arm("replica.promote_fail")
+        em.run_once()
+        # the injected promote failure must not wedge the fleet: the
+        # lease is released (not left to bake out its TTL)...
+        assert em.role == "follower"
+        assert "promotion failed" in em.last_transition["reason"]
+        assert not store.fence_check("b", 1)
+        assert promoted == []
+        # ...and the next tick re-elects cleanly with a NEW term
+        em.run_once()
+        assert em.role == "leader"
+        assert em.term == 2
+        assert promoted == [True]
+        terms = [r["term"] for r in store.lineage()]
+        assert terms == [1, 2]
+
+    def test_split_heartbeat_cannot_mint_a_second_term(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        assert store.acquire("a", 30.0) is not None
+        em = manager(store, "b", clock)
+        FAULTS.arm("election.split_heartbeat")
+        em.run_once()  # false suspicion -> premature campaign
+        # the live lease's flock CAS rejects the early candidacy: no
+        # second term, no role change, lineage untouched
+        assert em.role == "follower"
+        assert [r["term"] for r in store.lineage()] == [1]
+        assert em.observed_term == 1
+        # with the fault drained, a normal tick just follows the leader
+        em.run_once()
+        assert em.role == "follower"
+
+    def test_candidacy_rank_orders_by_priority_then_position(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        em = manager(store, "b", clock, position_fn=lambda: 50)
+        em.observe_peers({
+            "members": [
+                # the dying leader never counts
+                {"instance_id": "L", "role": "leader", "alive": True,
+                 "version": 999},
+                # better replicated position -> ahead of us
+                {"instance_id": "c", "alive": True, "version": 80,
+                 "election": {"priority": 0}},
+                # dead peers don't rank
+                {"instance_id": "d", "alive": False, "version": 500,
+                 "election": {"priority": 5}},
+                # worse position -> behind us
+                {"instance_id": "e", "alive": True, "version": 10,
+                 "election": {"priority": 0}},
+            ]
+        })
+        assert em.candidacy_rank() == 1
+        # configured priority trumps position
+        em.priority = 1
+        assert em.candidacy_rank() == 0
+
+    def test_rank_ties_break_on_instance_id(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        em = manager(store, "b", clock, position_fn=lambda: 50)
+        peers = {
+            "members": [
+                {"instance_id": "a", "alive": True, "version": 50,
+                 "election": {"priority": 0}},
+                {"instance_id": "c", "alive": True, "version": 50,
+                 "election": {"priority": 0}},
+            ]
+        }
+        em.observe_peers(peers)
+        # identical (priority, position): smaller id goes first, so "b"
+        # yields to "a" but not to "c" — a total order, no shared slots
+        assert em.candidacy_rank() == 1
+
+    def test_clean_stop_releases_for_fast_failover(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        em = manager(store, "a", clock)
+        assert em.ensure_leadership()
+        em.stop(release=True)
+        # successor acquires without waiting out the TTL
+        lease = store.acquire("b", 3.0)
+        assert lease is not None and lease["term"] == 2
+
+    def test_status_surfaces_term_and_lease(self, tmp_path):
+        clock = FakeClock()
+        store = LeaseStore(str(tmp_path), clock=clock)
+        em = manager(store, "a", clock)
+        assert em.ensure_leadership()
+        doc = em.status()
+        assert doc["role"] == "leader"
+        assert doc["term"] == 1
+        assert doc["observed_term"] == 1
+        assert doc["leader_id"] == "a"
+        assert doc["lease_expires_in_s"] == pytest.approx(3.0)
+        assert doc["transitions"] == 1
+        assert doc["last_transition"]["reason"] == "bootstrap"
+        assert json.dumps(doc)  # JSON-serializable for /cluster/status

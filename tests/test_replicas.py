@@ -289,3 +289,126 @@ class TestHedgedReads:
         assert out.hedged is True and out.hedge_won is False
         fired, won, wasted = counters
         assert (fired.value, won.value, wasted.value) == (1, 0, 1)
+
+
+class TestEndpointRouter:
+    """Health-aware routing (client/hedge.py EndpointRouter): decaying
+    error penalties — a transiently failing endpoint returns to rotation
+    after ~one half-life instead of eating a penalty box forever — plus
+    /cluster/status health demotion and leader tracking across terms."""
+
+    def _router(self, n=3, cool_off_s=1.0):
+        from keto_tpu.client.hedge import EndpointRouter
+
+        clock = [100.0]
+        eps = [f"http://r{i}:1" for i in range(n)]
+        return (
+            EndpointRouter(
+                eps, cool_off_s=cool_off_s, clock=lambda: clock[0]
+            ),
+            eps,
+            clock,
+        )
+
+    def test_error_benches_then_decays_back(self):
+        router, eps, clock = self._router(n=2, cool_off_s=1.0)
+        router.observe_error(eps[0])
+        # fresh error: score 1.0 -> benched, all picks avoid it
+        assert router.snapshot()[eps[0]]["benched"] is True
+        for _ in range(6):
+            primary, _hedge = router.pick()
+            assert primary == eps[1]
+        # one half-life later the score is 0.5: recovered, no reset call
+        clock[0] += 1.0
+        snap = router.snapshot()[eps[0]]
+        assert snap["benched"] is False
+        assert snap["error_score"] == pytest.approx(0.5)
+        assert any(router.pick()[0] == eps[0] for _ in range(4))
+
+    def test_repeat_offender_benched_longer_never_forever(self):
+        router, eps, clock = self._router(n=2, cool_off_s=1.0)
+        for _ in range(8):
+            router.observe_error(eps[0])
+        score = router.snapshot()[eps[0]]["error_score"]
+        assert score == pytest.approx(8.0)
+        # one half-life halves it — still benched (4.0 >= 1.0) ...
+        clock[0] += 1.0
+        assert router.snapshot()[eps[0]]["benched"] is True
+        # ... log2(8)=3 half-lives bring it to exactly 1.0; past that
+        # the endpoint is back (bounded penalty, never permanent)
+        clock[0] += 2.5
+        assert router.snapshot()[eps[0]]["benched"] is False
+
+    def test_error_score_is_capped(self):
+        router, eps, clock = self._router(n=2, cool_off_s=1.0)
+        for _ in range(100):
+            router.observe_error(eps[0])
+        assert router.snapshot()[eps[0]]["error_score"] <= 16.0
+        # so even a long outage decays back within log2(16)=4 half-lives
+        clock[0] += 4.01
+        assert router.snapshot()[eps[0]]["benched"] is False
+
+    def test_reads_never_stop_when_everything_is_benched(self):
+        router, eps, clock = self._router(n=2)
+        for e in eps:
+            router.observe_error(e)
+        primary, hedge = router.pick()
+        assert primary in eps and hedge in eps and primary != hedge
+
+    def test_red_health_demotes_like_errors(self):
+        router, eps, clock = self._router(n=2)
+        router.observe_status(
+            {
+                "members": [
+                    {"instance_id": "r0", "read_url": eps[0],
+                     "health": "red", "alive": True, "version": 9},
+                    {"instance_id": "r1", "read_url": eps[1],
+                     "health": "green", "alive": True, "version": 9},
+                ]
+            }
+        )
+        for _ in range(4):
+            assert router.pick()[0] == eps[1]
+        # the rollup also pre-warmed the freshness map
+        assert router.snapshot()[eps[0]]["known_version"] == 9
+        # a recovered rollup restores it
+        router.observe_status(
+            {
+                "members": [
+                    {"instance_id": "r0", "read_url": eps[0],
+                     "health": "green", "alive": True},
+                ]
+            }
+        )
+        assert any(router.pick()[0] == eps[0] for _ in range(4))
+
+    def test_leader_follows_hints_but_rejects_stale_terms(self):
+        router, eps, clock = self._router(n=2)
+        router.observe_leader(
+            {"leader_id": "b", "term": 3,
+             "read_url": eps[1], "write_url": "http://w1:2"}
+        )
+        assert router.leader()["write_url"] == "http://w1:2"
+        # a fenced ex-leader's lower-term hint must not win back traffic
+        router.observe_leader(
+            {"leader_id": "a", "term": 2,
+             "read_url": eps[0], "write_url": "http://w0:2"}
+        )
+        assert router.leader()["write_url"] == "http://w1:2"
+        router.observe_leader(
+            {"leader_id": "c", "term": 4,
+             "read_url": eps[0], "write_url": "http://w0:2"}
+        )
+        assert router.leader()["term"] == 4
+
+    def test_freshness_map_survives_a_term_change(self):
+        router, eps, clock = self._router(n=2)
+        router.observe_version(eps[0], 40)
+        router.observe_version(eps[1], 55)
+        router.observe_status(
+            {"cluster": {"election": {"observed_term": 7}}, "members": []}
+        )
+        # snaptoken routing keeps honoring known versions mid-election:
+        # versions are preserved across promotion (shared-WAL replay)
+        assert router.pick(min_version=50)[0] == eps[1]
+        assert router.snapshot()[eps[0]]["known_version"] == 40

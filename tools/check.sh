@@ -22,9 +22,12 @@
 #      mixed load; answer parity, snaptoken monotonicity, no lost
 #      futures, bounded p99; plus the kill-and-restart drill (SIGKILL at
 #      every WAL/checkpoint fault site, post-recovery parity vs a shadow
-#      oracle) and the device-fault drills (--device-chaos: OOM batch
+#      oracle), the device-fault drills (--device-chaos: OOM batch
 #      bisection parity, compile-failure quarantine, device-loss
-#      failover with bounded recovery)
+#      failover with bounded recovery), and the game-day election drill
+#      (--election: SIGKILL the elected leader mid-traffic; a follower
+#      must win the lease within 2x TTL with zero acked-write loss,
+#      reads never stop, exactly one fencing-token lineage)
 #   5. replication gate — 1 leader + 2 followers in-process: checkpoint
 #      bootstrap + WAL-tail convergence under a lag bound, token-
 #      consistent reads on followers (wait AND bounce paths), read-only
@@ -33,7 +36,11 @@
 #      on the leader's /cluster/status, the leader's federated /metrics
 #      (instance-labeled keto_cluster_* series) lints clean in both
 #      exposition formats, and a hedged check pair stitches into ONE
-#      cross-process trace on the leader's /debug/traces
+#      cross-process trace on the leader's /debug/traces; ends with the
+#      fast election drill: leader killed WITHOUT releasing its lease,
+#      one follower self-promotes inside the bound, the demoted peer's
+#      503 leader_hint is followed by the client, the loser retargets
+#      its WAL tail, and the on-disk fencing lineage stays one chain
 #   6. metrics lint — boot the serving stack (cluster federation on, so
 #      the self-federated keto_cluster_* series are linted too), drive
 #      traffic, scrape /metrics from both planes in Prometheus-text and
@@ -73,7 +80,7 @@ echo "== bench smoke =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python bench.py --smoke || exit 1
 
 echo "== chaos soak smoke =="
-timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/soak.py --smoke --seed 4 --pool --restart --device-chaos || exit 1
+timeout -k 10 330 env JAX_PLATFORMS=cpu python tools/soak.py --smoke --seed 4 --pool --restart --device-chaos --election || exit 1
 
 echo "== replication gate =="
 timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/replication_gate.py || exit 1

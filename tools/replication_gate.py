@@ -51,13 +51,24 @@ CLUSTER_BOUND_S = 20.0  # heartbeat + federation-scrape settle bound
 DEBUG_TOKEN = "replgate-debug"
 
 
-def _cluster(instance_id: str) -> dict:
-    return {
+ELECTION_BOUND_S = 15.0  # leader kill -> promoted follower bound
+
+
+def _cluster(instance_id: str, election_wal: str = "") -> dict:
+    doc = {
         "enabled": True,
         "instance_id": instance_id,
         "heartbeat_interval_ms": 100,
         "scrape_interval_ms": 200,
     }
+    if election_wal:
+        doc["election"] = {
+            "enabled": True,
+            "lease_ttl_s": 1.0,
+            "heartbeat_interval_ms": 100,
+            "wal_dir": election_wal,
+        }
+    return doc
 
 
 class _Node:
@@ -118,7 +129,9 @@ def main() -> int:
                     "dsn": "memory",
                     "store": {"wal": {"dir": os.path.join(root, "wal")}},
                     "replication": {"role": "leader", "poll_interval_ms": 10},
-                    "cluster": _cluster("leader-0"),
+                    "cluster": _cluster(
+                        "leader-0", election_wal=os.path.join(root, "wal")
+                    ),
                     "debug": {"token": DEBUG_TOKEN},
                 }
             )
@@ -160,7 +173,10 @@ def main() -> int:
                                 "dir": os.path.join(root, f"f{i}"),
                                 "poll_interval_ms": 10,
                             },
-                            "cluster": _cluster(f"follower-{i}"),
+                            "cluster": _cluster(
+                                f"follower-{i}",
+                                election_wal=os.path.join(root, "wal"),
+                            ),
                             "debug": {"token": DEBUG_TOKEN},
                         }
                     )
@@ -383,6 +399,160 @@ def main() -> int:
         lag_panels = [
             f.registry.replicator().lag() for f in followers
         ]
+
+        # -- automated failover: kill the leader, the fleet self-drives -----
+        # The leader dies WITHOUT releasing its lease (crash semantics):
+        # the survivors must notice the TTL lapse, elect by replication
+        # position, replay the shared WAL tail, and open their write
+        # plane — all while reads keep answering.
+        em = leader.registry._election
+        if em is None:
+            violations.append("election: leader built no ElectionManager")
+        else:
+            em.stop(release=False)
+            leader.registry._election = None
+        leader.stop()
+        nodes.remove(leader)
+        t_kill = time.monotonic()
+
+        reads_ok, reads_bad = 0, 0
+        winner = loser = None
+        deadline = time.monotonic() + ELECTION_BOUND_S
+        while time.monotonic() < deadline:
+            for f in followers:
+                r = http.get(
+                    f"http://127.0.0.1:{f.read_port}/check",
+                    params=_params("tail19"),
+                )
+                if r.status_code == 200:
+                    reads_ok += 1
+                else:
+                    reads_bad += 1
+            promoted = [
+                f for f in followers
+                if f.registry._election is not None
+                and f.registry._election.role == "leader"
+            ]
+            if len(promoted) == 1:
+                winner = promoted[0]
+                loser = next(f for f in followers if f is not winner)
+                break
+            time.sleep(0.1)
+        failover_s = time.monotonic() - t_kill
+        if reads_bad:
+            violations.append(
+                f"election: {reads_bad} reads failed during failover "
+                f"({reads_ok} ok) — reads must never stop"
+            )
+        if winner is None:
+            violations.append(
+                "election: no follower promoted within "
+                f"{ELECTION_BOUND_S}s"
+            )
+        else:
+            new_write = f"http://127.0.0.1:{winner.write_port}"
+
+            # the winner's own /cluster/status names it leader with a
+            # bumped term (satellite: election state on the status doc)
+            r = http.get(
+                f"http://127.0.0.1:{winner.read_port}/cluster/status"
+            )
+            edoc = {}
+            if r.status_code == 200:
+                edoc = (r.json().get("cluster") or {}).get("election") or {}
+            if edoc.get("role") != "leader" or edoc.get("term", 0) < 2:
+                violations.append(
+                    f"election: winner /cluster/status election doc is "
+                    f"{edoc!r}, want role=leader term>=2"
+                )
+
+            # the promoted write plane opens: a direct write answers 201
+            r = http.put(
+                f"{new_write}/relation-tuples",
+                json={
+                    "namespace": "n", "object": "post-failover",
+                    "relation": "view", "subject_id": "alice",
+                },
+            )
+            if r.status_code != 201:
+                violations.append(
+                    f"election: promoted write plane answered "
+                    f"{r.status_code}: {r.text[:120]}"
+                )
+
+            # the demoted peer still refuses writes — but its 503 now
+            # carries the new leader's coordinates, and the client
+            # follows them without operator help
+            r = http.put(
+                f"http://127.0.0.1:{loser.write_port}/relation-tuples",
+                json={
+                    "namespace": "n", "object": "misrouted",
+                    "relation": "view", "subject_id": "alice",
+                },
+            )
+            hint = {}
+            if r.status_code == 503:
+                hint = (
+                    (r.json().get("error") or {}).get("details") or {}
+                ).get("leader_hint") or {}
+            if hint.get("write_url") != new_write:
+                violations.append(
+                    f"election: loser 503 leader_hint {hint!r} does not "
+                    f"point at {new_write}"
+                )
+            from keto_tpu.client import ReplicatedRestClient as _RC2
+
+            with _RC2(
+                [f"http://127.0.0.1:{f.read_port}" for f in followers],
+                write_url=f"http://127.0.0.1:{loser.write_port}",
+            ) as rc:
+                try:
+                    rc.create_relation_tuple(
+                        "n:follow-the-hint#view@alice"
+                    )
+                except Exception as e:  # noqa: BLE001
+                    violations.append(
+                        f"election: client did not follow leader_hint: "
+                        f"{e!r}"
+                    )
+
+            # the loser retargeted its tail at the winner and converges
+            # on post-failover writes with no re-bootstrap
+            up = loser.registry.replicator().upstream.rstrip("/")
+            if up != new_write:
+                violations.append(
+                    f"election: loser still tails {up}, not {new_write}"
+                )
+            deadline = time.monotonic() + LAG_BOUND_S
+            converged = False
+            while time.monotonic() < deadline and not converged:
+                r = http.get(
+                    f"http://127.0.0.1:{loser.read_port}/check",
+                    params=_params("follow-the-hint"),
+                )
+                converged = (
+                    r.status_code == 200 and r.json().get("allowed")
+                )
+                if not converged:
+                    time.sleep(0.05)
+            if not converged:
+                violations.append(
+                    "election: post-failover write never reached the "
+                    f"retargeted loser within {LAG_BOUND_S}s"
+                )
+
+        # exactly one strictly-increasing fencing-token lineage on disk
+        from keto_tpu.cluster.election import LeaseStore
+
+        lineage = LeaseStore(os.path.join(root, "wal")).lineage()
+        terms = [rec["term"] for rec in lineage]
+        if len(terms) < 2 or any(
+            b - a != 1 for a, b in zip(terms, terms[1:])
+        ):
+            violations.append(
+                f"election: fencing lineage is not one chain: {terms}"
+            )
+
         summary = {
             "ok": not violations,
             "leader_token": token_tail,
@@ -399,6 +569,17 @@ def main() -> int:
             "stitched_instances": sorted(
                 (stitched or {}).get("instances") or []
             ),
+            "election": {
+                "failover_s": round(failover_s, 2),
+                "winner": (
+                    winner.registry._election.instance_id
+                    if winner is not None
+                    and winner.registry._election is not None
+                    else None
+                ),
+                "lineage_terms": terms,
+                "reads_during_failover": reads_ok,
+            },
             "elapsed_s": round(time.monotonic() - t0, 2),
             "violations": violations,
         }

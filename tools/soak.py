@@ -254,6 +254,104 @@ def _promotion_child_main(spec_raw: str) -> int:
     return 0
 
 
+def _election_child_main(spec_raw: str) -> int:
+    """Elected-leader process of the game-day drill (``--election``): a
+    durable store, the real replication routes, AND a real
+    :class:`ElectionManager` holding the fencing-token lease over the
+    shared WAL directory. Every streamed write is gated on
+    ``is_writable()`` — the per-mutation fence the write plane uses —
+    and acked only after its WAL frame is durable. SIGKILLs itself right
+    after the ``kill_at`` write lands durably but BEFORE its ack, with
+    the lease deliberately un-released: the survivors must wait out the
+    TTL, exactly like a real power-cord failover."""
+    import asyncio
+    import signal
+
+    from aiohttp import web
+
+    spec = json.loads(spec_raw)
+    from keto_tpu.cluster.election import ElectionManager, LeaseStore
+    from keto_tpu.relationtuple.definitions import (
+        RelationTuple,
+        SubjectID,
+    )
+    from keto_tpu.replication.leader import ReplicationSource
+    from keto_tpu.store import DurableTupleStore, InMemoryTupleStore
+    from keto_tpu.store.wal import encode_tuple
+
+    def emit(obj) -> None:
+        print(json.dumps(obj), flush=True)
+
+    store = DurableTupleStore(
+        InMemoryTupleStore(),
+        spec["dir"],
+        sync="always",  # WAL-before-ack: the zero-loss invariant
+        checkpoint_interval_versions=10**9,
+        checkpoint_interval_s=0.0,
+    )
+    rng = random.Random(int(spec["seed"]) * 7919)
+    ops = int(spec["ops"])
+    kill_at = int(spec["kill_at"])
+
+    def write_op(i: int) -> None:
+        t = RelationTuple(
+            namespace="n", object=f"gameday{i}", relation="view",
+            subject=SubjectID(id=f"u{rng.randrange(5)}"),
+        )
+        emit({"op": i, "t": encode_tuple(t)})
+        store.write_relation_tuples(t)
+
+    prefix = max(1, ops // 3)
+    for i in range(prefix):
+        write_op(i)
+        emit({"ack": i, "version": store.version})
+    store.checkpoint_now()
+
+    src = ReplicationSource(store, poll_interval_s=0.01)
+    app = web.Application()
+    src.register(app)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+
+    async def _serve() -> int:
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        return site._server.sockets[0].getsockname()[1]
+
+    port = asyncio.run_coroutine_threadsafe(_serve(), loop).result(
+        timeout=60
+    )
+    em = ElectionManager(
+        LeaseStore(spec["dir"]),
+        instance_id="gameday-leader",
+        lease_ttl_s=float(spec["ttl"]),
+        heartbeat_interval_s=float(spec["hb"]),
+        write_url=f"http://127.0.0.1:{port}",
+    )
+    if not em.ensure_leadership():
+        emit({"error": "leader could not take the bootstrap lease"})
+        return 1
+    em.start()  # renews every hb; SIGKILL leaves the lease to expire
+    emit({"ready": True, "port": port, "version": store.version,
+          "term": em.term})
+    sys.stdin.readline()  # followers seeded: start live traffic
+
+    for i in range(prefix, ops):
+        if not em.is_writable():
+            emit({"fenced": i})
+            break
+        write_op(i)
+        if i == kill_at:
+            # durable but unacked, lease un-released: the real crash
+            os.kill(os.getpid(), signal.SIGKILL)
+        emit({"ack": i, "version": store.version})
+        time.sleep(0.01)
+    emit({"done": True})
+    return 0
+
+
 if "--restart-child" in sys.argv:
     # handled BEFORE the keto_tpu.driver import below: the child only
     # needs the store layer, not the engine stack
@@ -265,6 +363,13 @@ if "--promotion-child" in sys.argv:
     sys.exit(
         _promotion_child_main(
             sys.argv[sys.argv.index("--promotion-child") + 1]
+        )
+    )
+
+if "--election-child" in sys.argv:
+    sys.exit(
+        _election_child_main(
+            sys.argv[sys.argv.index("--election-child") + 1]
         )
     )
 
@@ -1224,6 +1329,377 @@ def run_promotion_drill(seed: int, ops: int = 60) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+class _GamedayFollower:
+    """One follower of the game-day fleet: a real FollowerReplicator
+    tailing the leader, a real ElectionManager over the shared lease,
+    and an aiohttp surface whose ``/replication/*`` routes come alive
+    the moment this node is promoted (the deferred-route pattern the
+    write plane uses)."""
+
+    def __init__(self, name: str, wal_dir: str, upstream: str,
+                 scratch: str, loop, *, ttl: float, hb: float):
+        from aiohttp import web
+
+        from keto_tpu.cluster.election import (
+            ElectionManager,
+            LeaseStore,
+            PromotedReplicationSource,
+        )
+        from keto_tpu.replication.follower import FollowerReplicator
+        from keto_tpu.store import InMemoryTupleStore
+
+        self.name = name
+        self.wal_dir = wal_dir
+        self.store = InMemoryTupleStore()
+        self.rep = FollowerReplicator(
+            self.store, upstream, scratch_dir=scratch,
+            poll_interval_s=0.01,
+        )
+        self.promoted_src = None
+        self._src_cls = PromotedReplicationSource
+
+        async def h_status(request):
+            src = self.promoted_src
+            if src is not None:
+                return await src.handle_status(request)
+            return web.json_response(self.rep.lag())
+
+        async def h_blocked(request):
+            src = self.promoted_src
+            if src is not None:
+                if request.path.endswith("/checkpoint"):
+                    return await src.handle_checkpoint(request)
+                return await src.handle_wal(request)
+            return web.json_response(
+                {"error": "not the replication leader"}, status=503
+            )
+
+        app = web.Application()
+        app.router.add_get("/replication/status", h_status)
+        app.router.add_get("/replication/checkpoint", h_blocked)
+        app.router.add_get("/replication/wal", h_blocked)
+
+        async def _serve() -> int:
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            return site._server.sockets[0].getsockname()[1]
+
+        import asyncio
+
+        self.port = asyncio.run_coroutine_threadsafe(
+            _serve(), loop
+        ).result(timeout=60)
+        self.write_url = f"http://127.0.0.1:{self.port}"
+        self.em = ElectionManager(
+            LeaseStore(wal_dir),
+            instance_id=name,
+            lease_ttl_s=ttl,
+            heartbeat_interval_s=hb,
+            write_url=self.write_url,
+            promote_fn=self._promote,
+            retarget_fn=lambda lease: self.rep.retarget(
+                str(lease.get("write_url") or "")
+            ),
+            position_fn=lambda: self.store.version,
+        )
+
+    def _promote(self) -> dict:
+        report = self.rep.promote(self.wal_dir)
+        src = self._src_cls(self.store, self.wal_dir)
+        src.open()
+        self.promoted_src = src
+        return report
+
+    def start(self) -> None:
+        self.rep.start()
+        self.em.start()
+
+    def stop(self) -> None:
+        self.em.stop()
+        if self.promoted_src is not None:
+            self.promoted_src.close()
+        self.rep.stop()
+
+
+def run_election_drill(seed: int, ops: int = 60) -> dict:
+    """Game day: SIGKILL the elected leader mid-traffic and watch the
+    fleet drive itself. One leader child (durable store + replication
+    routes + the lease), two in-process followers tailing it, each
+    running a real ElectionManager over the shared WAL directory.
+
+    Asserted, in the order the ISSUE states them:
+
+    - a new leader holds the lease within the failover budget (the dead
+      leader's lease had at most one TTL to run, plus campaign time);
+    - ZERO acked writes are lost — the shadow oracle built from the
+      child's INTENT/ACK stream is a subset of the promoted store; at
+      most the one durable-but-unacked op surfaces as an extra;
+    - reads never stop: a reader hammers both followers' stores through
+      the whole window (kill included) with bounded p99 and no errors;
+    - the fencing-token lineage on disk is exactly one strictly
+      increasing chain, ending at the new leader's term;
+    - the loser retargets its tail at the winner and converges on
+      post-failover writes without a re-bootstrap.
+    """
+    import asyncio
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from keto_tpu.cluster.election import LeaseStore
+    from keto_tpu.relationtuple.definitions import RelationTuple, SubjectID
+    from keto_tpu.store.wal import encode_tuple
+
+    t0 = time.monotonic()
+    viol = _Violations()
+    root = tempfile.mkdtemp(prefix="keto-gameday-")
+    wal_dir = os.path.join(root, "wal")
+    ttl, hb = 2.0, 0.25
+    rng = random.Random(seed + 47)
+    kill_at = rng.randrange((ops * 2) // 3, ops - 2)
+    spec = {
+        "dir": wal_dir, "ops": ops, "seed": seed, "kill_at": kill_at,
+        "ttl": ttl, "hb": hb,
+    }
+    summary = {"phase": "election", "seed": seed, "kill_at": kill_at,
+               "lease_ttl_s": ttl}
+    followers: list[_GamedayFollower] = []
+    proc = None
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    read_errors: list[str] = []
+    read_lat: list[float] = []
+    stop_reads = threading.Event()
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--election-child", json.dumps(spec)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        lines: list[dict] = []
+
+        def _take(raw: str):
+            try:
+                doc = json.loads(raw)
+            except json.JSONDecodeError:
+                viol.add(f"election: undecodable child line {raw!r}")
+                return None
+            lines.append(doc)
+            return doc
+
+        port = None
+        for raw in proc.stdout:
+            doc = _take(raw)
+            if doc and doc.get("ready"):
+                port = doc["port"]
+                if doc.get("term") != 1:
+                    viol.add(
+                        f"election: bootstrap term {doc.get('term')} != 1"
+                    )
+                break
+        if port is None:
+            err = proc.stderr.read()[-400:] if proc.stderr else ""
+            viol.add(f"election: leader child never became ready ({err!r})")
+            return {**summary, "violations": viol.items}
+
+        upstream = f"http://127.0.0.1:{port}"
+        for i in range(2):
+            f = _GamedayFollower(
+                f"gameday-f{i}", wal_dir, upstream,
+                os.path.join(root, f"f{i}"), loop, ttl=ttl, hb=hb,
+            )
+            followers.append(f)
+        # seed each follower's peer cache so candidacy ranks are a total
+        # order (equal priority/position ties break on instance id)
+        members = [
+            {"instance_id": f.name, "alive": True,
+             "version": f.store.version, "election": {"priority": 0}}
+            for f in followers
+        ]
+        for f in followers:
+            f.em.observe_peers({"members": members})
+            f.start()
+        deadline = time.monotonic() + 30.0
+        while any(f.store.version <= 0 for f in followers):
+            if time.monotonic() > deadline:
+                viol.add("election: followers never seeded from leader")
+                return {**summary, "violations": viol.items}
+            time.sleep(0.05)
+
+        def reader() -> None:
+            i = 0
+            while not stop_reads.is_set():
+                f = followers[i % len(followers)]
+                i += 1
+                t_r = time.monotonic()
+                try:
+                    _ = f.store.version
+                    f.store.all_tuples()
+                except Exception as e:  # noqa: BLE001
+                    read_errors.append(repr(e))
+                read_lat.append(time.monotonic() - t_r)
+                time.sleep(0.005)
+
+        reads = threading.Thread(target=reader, daemon=True)
+        reads.start()
+
+        proc.stdin.write("go\n")
+        proc.stdin.flush()
+        for raw in proc.stdout:  # drains until SIGKILL closes the pipe
+            _take(raw)
+        proc.wait(timeout=60)
+        t_kill = time.monotonic()
+        if any("done" in l for l in lines):
+            viol.add(f"election: leader was never killed (kill_at={kill_at})")
+        if any("fenced" in l for l in lines):
+            viol.add("election: live leader saw its own fence fail")
+
+        # -- a new leader within the failover budget ------------------------
+        # the lease had at most one TTL to run at the kill; allow one
+        # more TTL for detection + stagger + promotion (CI-safe, still
+        # an order of magnitude under "page an operator")
+        budget = 2.0 * ttl
+        winner = None
+        while winner is None and time.monotonic() - t_kill < budget + 5.0:
+            winner = next(
+                (f for f in followers if f.em.role == "leader"), None
+            )
+            if winner is None:
+                time.sleep(0.02)
+        failover_s = time.monotonic() - t_kill
+        if winner is None:
+            viol.add(f"election: no new leader within {budget + 5.0:.0f}s")
+            return {**summary, "violations": viol.items}
+        if failover_s > budget:
+            viol.add(
+                f"election: failover took {failover_s:.2f}s "
+                f"(budget {budget:.2f}s = 2x lease TTL)"
+            )
+        loser = next(f for f in followers if f is not winner)
+
+        # -- zero acked-write loss (shadow-oracle parity) -------------------
+        acked = {l["ack"] for l in lines if "ack" in l}
+        intents = {l["op"]: tuple(l["t"]) for l in lines if "op" in l}
+        oracle = {intents[i] for i in acked}
+        unacked = {intents[i] for i in intents if i not in acked}
+        got = {
+            tuple(encode_tuple(t)) for t in winner.store.all_tuples()
+        }
+        lost = oracle - got
+        if lost:
+            viol.add(
+                f"election: {len(lost)} acked writes missing on the "
+                "promoted leader"
+            )
+        phantom = got - oracle - unacked
+        if phantom:
+            viol.add(
+                f"election: {len(phantom)} phantom tuples on the "
+                "promoted leader"
+            )
+
+        # -- exactly one fencing-token lineage ------------------------------
+        lineage = LeaseStore(wal_dir).lineage()
+        terms = [r["term"] for r in lineage]
+        if terms != sorted(set(terms)) or any(
+            b - a != 1 for a, b in zip(terms, terms[1:])
+        ):
+            viol.add(f"election: fencing lineage not one chain: {terms}")
+        if not lineage or lineage[-1]["leader_id"] != winner.em.instance_id:
+            viol.add(
+                f"election: lineage tip {lineage[-1:]} is not the "
+                f"winner {winner.em.instance_id}"
+            )
+        if sum(1 for f in followers if f.em.role == "leader") != 1:
+            viol.add("election: more than one in-process leader")
+        if not winner.em.is_writable():
+            viol.add("election: winner fails its own fence check")
+        if loser.em.is_writable():
+            viol.add("election: LOSER passes the write fence")
+
+        # -- the loser retargets and converges without re-bootstrap ---------
+        post = []
+        for i in range(5):
+            t = RelationTuple(
+                namespace="n", object=f"post{i}", relation="view",
+                subject=SubjectID(id="u0"),
+            )
+            if not winner.em.is_writable():
+                viol.add("election: winner lost writability mid-write")
+                break
+            winner.store.write_relation_tuples(t)
+            post.append(tuple(encode_tuple(t)))
+        deadline = time.monotonic() + 15.0
+        while (
+            loser.store.version < winner.store.version
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        if loser.rep.upstream.rstrip("/") != winner.write_url:
+            viol.add(
+                f"election: loser still tails {loser.rep.upstream!r}, "
+                f"not the winner {winner.write_url!r}"
+            )
+        loser_got = {
+            tuple(encode_tuple(t)) for t in loser.store.all_tuples()
+        }
+        missing_post = [p for p in post if p not in loser_got]
+        if missing_post:
+            viol.add(
+                f"election: {len(missing_post)} post-failover writes "
+                "never reached the retargeted loser"
+            )
+
+        # -- reads never stopped --------------------------------------------
+        stop_reads.set()
+        reads.join(timeout=5)
+        if read_errors:
+            viol.add(
+                f"election: {len(read_errors)} read errors during "
+                f"failover (first: {read_errors[0]})"
+            )
+        lat_sorted = sorted(read_lat)
+        p99 = _percentile(lat_sorted, 0.99) if lat_sorted else 0.0
+        if p99 > 1.0:
+            viol.add(f"election: read p99 {p99:.3f}s over the 1s budget")
+        if len(read_lat) < 50:
+            viol.add(
+                f"election: only {len(read_lat)} reads served — reads "
+                "effectively stopped"
+            )
+
+        summary.update(
+            {
+                "acked_ops": len(acked),
+                "failover_s": round(failover_s, 3),
+                "winner": winner.em.instance_id,
+                "winner_term": winner.em.term,
+                "lineage_terms": terms,
+                "reads_served": len(read_lat),
+                "read_p99_s": round(p99, 4),
+                "elapsed_s": round(time.monotonic() - t0, 2),
+                "violations": viol.items,
+            }
+        )
+        return summary
+    finally:
+        stop_reads.set()
+        for f in followers:
+            try:
+                f.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        loop.call_soon_threadsafe(loop.stop)
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=4)
@@ -1246,6 +1722,12 @@ def main(argv=None) -> int:
         "--device-chaos", action="store_true",
         help="also run the device-fault drills (OOM bisection, compile "
         "quarantine, device-loss failover)",
+    )
+    ap.add_argument(
+        "--election", action="store_true",
+        help="also run the game-day failover drill (SIGKILL the elected "
+        "leader mid-traffic; assert failover within the lease budget, "
+        "zero acked-write loss, bounded reads, one fencing lineage)",
     )
     args = ap.parse_args(argv)
 
@@ -1274,6 +1756,10 @@ def main(argv=None) -> int:
         )
         phases.append(
             run_promotion_drill(args.seed, ops=60 if args.smoke else 150)
+        )
+    if args.election:
+        phases.append(
+            run_election_drill(args.seed, ops=60 if args.smoke else 150)
         )
     bad = [v for p in phases for v in p["violations"]]
     print(json.dumps({"phases": phases, "ok": not bad}, indent=2))
