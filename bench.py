@@ -2184,6 +2184,115 @@ def _sharded_serving_child():
         checker.close()
 
 
+def run_list_serving_bench() -> None:
+    """The list-serving path (PR 17): list_objects answered from the
+    reverse closure residency (D^T row gathers, engine/listing.py) on an
+    rbac-shaped store. The headline gains ``list_objects_rps`` /
+    ``list_p50_ms`` / ``list_p95_ms`` (query-side) plus
+    ``reverse_build_s`` and ``reverse_residency_bytes`` (the one-time
+    cost of the transpose and what it holds resident) so vs_prev
+    regression flagging covers listing alongside checks."""
+    from keto_tpu.engine.closure import ClosureCheckEngine
+    from keto_tpu.engine.listing import ListEngine
+    from keto_tpu.graph.snapshot import SnapshotManager
+    from keto_tpu.relationtuple.definitions import (
+        RelationTuple,
+        SubjectID,
+        SubjectSet,
+    )
+    from keto_tpu.store.memory import InMemoryTupleStore
+
+    seconds = float(os.environ.get("BENCH_LIST_SECONDS", 3))
+    n_users = int(os.environ.get("BENCH_LIST_USERS", 200))
+    n_groups = int(os.environ.get("BENCH_LIST_GROUPS", 16))
+    n_roles = int(os.environ.get("BENCH_LIST_ROLES", 8))
+    n_resources = int(os.environ.get("BENCH_LIST_RESOURCES", 2000))
+
+    rng = np.random.default_rng(23)
+    tuples = []
+    for u in range(n_users):
+        for g in rng.choice(n_groups, 2, replace=False):
+            tuples.append(
+                RelationTuple("rbac", f"g{g}", "member", SubjectID(f"u{u}"))
+            )
+    for g in range(n_groups):
+        for r in rng.choice(n_roles, 2, replace=False):
+            tuples.append(
+                RelationTuple(
+                    "rbac", f"role{r}", "member",
+                    SubjectSet("rbac", f"g{g}", "member"),
+                )
+            )
+    for res in range(n_resources):
+        r = int(rng.integers(0, n_roles))
+        tuples.append(
+            RelationTuple(
+                "rbac", f"res{res}", "view",
+                SubjectSet("rbac", f"role{r}", "member"),
+            )
+        )
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(*tuples)
+
+    eng = ClosureCheckEngine(
+        SnapshotManager(store), max_depth=5, freshness="strong",
+        rebuild_debounce_s=0.0, query_mode="host",
+    )
+    le = ListEngine(eng)
+    # first reverse_artifacts() call pays the D^T transpose + reverse CSRs
+    art = eng.reverse_artifacts()
+    reverse_build_s = eng.last_reverse_build_s
+    residency = 0
+    if art is not None and art.d_rev is not None:
+        residency += int(art.d_rev.nbytes)
+    if art is not None and art.rev is not None:
+        residency += art.rev.residency_bytes()
+
+    subjects = [SubjectID(f"u{u}") for u in range(n_users)]
+    lat = []
+    n_items = 0
+    stop_at = time.monotonic() + seconds
+    t_loop = time.monotonic()
+    while time.monotonic() < stop_at:
+        subj = subjects[int(rng.integers(n_users))]
+        t0 = time.perf_counter()
+        page = le.list_objects(subj, "view", "rbac", max_depth=5)
+        lat.append(time.perf_counter() - t0)
+        n_items += len(page.items)
+    elapsed = time.monotonic() - t_loop
+    if not lat:
+        return
+    lat_ms = np.asarray(lat) * 1e3
+    summary = {
+        "tuples": len(tuples),
+        "resources": n_resources,
+        "seconds": round(elapsed, 2),
+        "queries": len(lat),
+        "items_returned": n_items,
+        "oracle_fallbacks": le.n_oracle,
+        "list_objects_rps": round(len(lat) / max(elapsed, 1e-9)),
+        "list_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "list_p95_ms": round(float(np.percentile(lat_ms, 95)), 3),
+        "reverse_build_s": reverse_build_s,
+        "reverse_residency_bytes": residency,
+    }
+    print(
+        json.dumps({"config": "list_serving", **summary}),
+        file=sys.stderr,
+        flush=True,
+    )
+    _EXTRA_HEADLINE["list_serving"] = summary
+    for key in (
+        "list_objects_rps",
+        "list_p50_ms",
+        "list_p95_ms",
+        "reverse_build_s",
+        "reverse_residency_bytes",
+    ):
+        _EXTRA_HEADLINE[key] = summary[key]
+    _heartbeat("list_serving", rps=summary["list_objects_rps"])
+
+
 def run_sharded_serving_bench(name: str) -> None:
     """Subprocess wrapper for _sharded_serving_child: JSON rungs land on
     stderr AND in the headline's ``sharded_serving`` list, and the best
@@ -2696,6 +2805,23 @@ def main():
                 flush=True,
             )
 
+    if os.environ.get("BENCH_LIST_SERVING", "1") == "1" and not _skip_phase(
+        "list_serving", 60.0
+    ):
+        try:
+            run_list_serving_bench()
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            print(
+                json.dumps(
+                    {"config": "list_serving", "error": repr(e)[:300]}
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+
     if os.environ.get("BENCH_SHARDED", "1") == "1" and not _skip_phase(
         "sharded", 120.0
     ):
@@ -2907,8 +3033,16 @@ _HIGHER_BETTER = (
     "batch_rps",
     "device_check_rps",
     "sharded_batch_rps",
+    "list_objects_rps",
 )
-_LOWER_BETTER = ("batch_p95_ms", "expand_p95_ms", "staleness_p95_ms")
+_LOWER_BETTER = (
+    "batch_p95_ms",
+    "expand_p95_ms",
+    "staleness_p95_ms",
+    "list_p50_ms",
+    "list_p95_ms",
+    "reverse_build_s",
+)
 
 
 def _trajectory(line: dict) -> tuple[dict | None, list[str]]:
@@ -2921,6 +3055,10 @@ def _trajectory(line: dict) -> tuple[dict | None, list[str]]:
     if prev is None:
         return None, []
     source, prev_line = prev
+    if not isinstance(prev_line, dict) or not prev_line:
+        # a malformed/empty prior headline (e.g. a bare `[]` tail line)
+        # yields no trajectory rather than a crash mid-summary
+        return None, []
     config_match = prev_line.get("config") == line.get(
         "config"
     ) and prev_line.get("backend") == line.get("backend")

@@ -27,12 +27,14 @@ from .services import (
     CheckServicer,
     ExpandServicer,
     HealthServicer,
+    ListServicer,
     ReadServicer,
     VersionServicer,
     WriteServicer,
     add_check_service,
     add_expand_service,
     add_health_service,
+    add_list_service,
     add_read_service,
     add_version_service,
     add_write_service,
@@ -185,10 +187,12 @@ def build_read_grpc_server(
     telemetry=None,  # CheckTelemetry seam (spans/exemplars/SLO/flight)
     version_waiter=None,  # follower replication gate (replication/follower.py)
     encoded_front=None,  # id-native wire tier (api/encoded.py), or None
+    list_engine=None,  # reverse-index list serving (engine/listing.py), or None
 ) -> grpc.Server:
     """Read-plane gRPC: Check + Expand + Read + Version + Health +
-    reflection, behind the telemetry interceptor chain (reference
-    ReadGRPCServer + interceptors, registry_default.go:337-385)."""
+    reflection (plus List when the reverse-index tier is on), behind the
+    telemetry interceptor chain (reference ReadGRPCServer + interceptors,
+    registry_default.go:337-385)."""
     executor = futures.ThreadPoolExecutor(
         max_workers=max_workers, thread_name_prefix="keto-grpc-read"
     )
@@ -220,9 +224,20 @@ def build_read_grpc_server(
             max_freshness_wait_s=max_freshness_wait_s,
         ),
     )
+    services = READ_SERVICES
+    if list_engine is not None:
+        add_list_service(
+            server,
+            ListServicer(
+                list_engine, snaptoken_fn, version_waiter=version_waiter,
+                max_freshness_wait_s=max_freshness_wait_s,
+                telemetry=telemetry,
+            ),
+        )
+        services = services + (f"{_PKG}.ListService",)
     add_version_service(server, VersionServicer(version))
     add_health_service(server, health)
-    add_reflection_service(server, READ_SERVICES)
+    add_reflection_service(server, services)
     return server
 
 def build_write_grpc_server(

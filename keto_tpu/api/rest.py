@@ -11,6 +11,14 @@ Read port (reference RegisterReadRoutes):
                                       wire amortize the same way instead of
                                       paying per-RPC overhead per check.
 - GET  /expand                        subject tree or null (expand/handler.go:77-91)
+- GET  /relation-tuples/list-objects  keto_tpu extension: every object the
+                                      subject holds a relation on, served by
+                                      the reverse-closure index (engine/
+                                      listing.py) with an exact oracle
+                                      fallback -> {"objects": [...]}
+- GET  /relation-tuples/list-subjects keto_tpu extension: every subject id an
+                                      object's relation resolves to ->
+                                      {"subject_ids": [...]}
 
 Write port (reference RegisterWriteRoutes):
 - PUT    /relation-tuples             create -> 201 + Location (transact_server.go:144-167)
@@ -62,6 +70,8 @@ ROUTE_CHECK_BATCH_ENCODED = "/check/batch-encoded"
 ROUTE_VOCAB_SNAPSHOT = "/vocab/snapshot"
 ROUTE_VOCAB_DELTAS = "/vocab/deltas"
 ROUTE_EXPAND = "/expand"
+ROUTE_LIST_OBJECTS = "/relation-tuples/list-objects"
+ROUTE_LIST_SUBJECTS = "/relation-tuples/list-subjects"
 
 #: the REST spelling of a gRPC deadline: milliseconds of budget the caller
 #: grants this request, measured from when the header is parsed
@@ -294,9 +304,12 @@ class ReadAPI:
     def __init__(
         self, manager, checker, expand_engine, snaptoken_fn, executor=None,
         telemetry=None, version_waiter=None, max_freshness_wait_s=30.0,
-        encoded_front=None,
+        encoded_front=None, list_engine=None,
     ):
         self.manager = manager
+        # reverse-index list serving (engine/listing.ListEngine); None when
+        # serve.read.list is off — the list routes are then not registered
+        self.list_engine = list_engine
         # id-native wire tier (api/encoded.EncodedCheckFront); None when
         # serve.read.encoded is off — the encoded/vocab routes are then
         # not registered at all
@@ -333,6 +346,9 @@ class ReadAPI:
             )
             app.router.add_get(ROUTE_VOCAB_DELTAS, self.get_vocab_deltas)
         app.router.add_get(ROUTE_EXPAND, self.get_expand)
+        if self.list_engine is not None:
+            app.router.add_get(ROUTE_LIST_OBJECTS, self.get_list_objects)
+            app.router.add_get(ROUTE_LIST_SUBJECTS, self.get_list_subjects)
         app.router.add_get("/pipeline", self.get_pipeline)
 
     def _await_freshness(self, min_version: int, deadline=None) -> None:
@@ -668,6 +684,90 @@ class ReadAPI:
         # herodot Write of a nil pointer (expand/handler.go:90)
         return web.json_response(None if tree is None else tree.to_dict())
 
+    def _list_page_params(self, p) -> tuple[int, str]:
+        try:
+            size = int(p.get("page_size", "0"))
+        except ValueError:
+            raise ErrMalformedInput("page_size must be an integer") from None
+        return size, p.get("page_token", "")
+
+    async def _list_response(self, request, items_key: str, run) -> web.Response:
+        """Shared list-route spine: freshness gate + telemetry record around
+        the engine call (executor thread), page serialized inside the
+        record so the ledger's serialize stage covers the json dump."""
+        p = request.rel_url.query
+        min_version = _min_version_from_query(p)
+        deadline = deadline_from_headers(request)
+        traceparent, hedge = _trace_from_headers(request)
+
+        def work():
+            with self.telemetry.record_check(
+                "rest_list", deadline=deadline,
+                detail={"namespace": p.get("namespace", "")},
+                traceparent=traceparent, hedge=hedge,
+            ) as rec:
+                self._await_freshness(min_version, deadline)
+                page = run(deadline, rec)
+                text = json.dumps(
+                    {
+                        items_key: page.items,
+                        "next_page_token": page.next_page_token,
+                        "snaptoken": self.snaptoken_fn(),
+                    }
+                )
+                rec.mark("serialize")
+                return text
+
+        text = await asyncio.get_running_loop().run_in_executor(
+            self.executor, work
+        )
+        return web.Response(text=text, content_type="application/json")
+
+    async def get_list_objects(self, request: web.Request) -> web.Response:
+        p = request.rel_url.query
+        for key in ("namespace", "relation"):
+            if p.get(key) is None:
+                raise ErrMalformedInput(f"missing query parameter {key}")
+        subject = subject_from_query(p, required=True)
+        depth = max_depth_from_query(p)
+        size, token = self._list_page_params(p)
+        return await self._list_response(
+            request,
+            "objects",
+            lambda deadline, rec: self.list_engine.list_objects(
+                subject=subject,
+                relation=p["relation"],
+                namespace=p["namespace"],
+                max_depth=depth,
+                page_size=size,
+                page_token=token,
+                deadline=deadline,
+                rec=rec,
+            ),
+        )
+
+    async def get_list_subjects(self, request: web.Request) -> web.Response:
+        p = request.rel_url.query
+        for key in ("namespace", "object", "relation"):
+            if p.get(key) is None:
+                raise ErrMalformedInput(f"missing query parameter {key}")
+        depth = max_depth_from_query(p)
+        size, token = self._list_page_params(p)
+        return await self._list_response(
+            request,
+            "subject_ids",
+            lambda deadline, rec: self.list_engine.list_subjects(
+                namespace=p["namespace"],
+                object=p["object"],
+                relation=p["relation"],
+                max_depth=depth,
+                page_size=size,
+                page_token=token,
+                deadline=deadline,
+                rec=rec,
+            ),
+        )
+
 
 class WriteAPI:
     def __init__(
@@ -818,7 +918,7 @@ def build_read_app(
     cors: Optional[dict] = None, healthy_fn=None, executor=None,
     logger=None, metrics=None, telemetry=None, debug=None,
     version_waiter=None, max_freshness_wait_s=30.0,
-    cluster_status_fn=None, encoded_front=None,
+    cluster_status_fn=None, encoded_front=None, list_engine=None,
 ) -> web.Application:
     # telemetry outermost (sees final codes), then CORS so error
     # responses also carry the headers
@@ -833,7 +933,7 @@ def build_read_app(
         manager, checker, expand_engine, snaptoken_fn, executor,
         telemetry=telemetry, version_waiter=version_waiter,
         max_freshness_wait_s=max_freshness_wait_s,
-        encoded_front=encoded_front,
+        encoded_front=encoded_front, list_engine=list_engine,
     ).register(app)
     register_common(app, version, healthy_fn, metrics)
     if cluster_status_fn is not None:

@@ -28,7 +28,12 @@ from ..faults import FAULTS
 from ..relationtuple.columns import CheckColumns, proto_has_columns
 from ..telemetry.flight import NOOP_CHECK_TELEMETRY
 from ..telemetry.tracing import HEDGE_HEADER, TRACEPARENT_HEADER
-from ..relationtuple.definitions import RelationQuery, RelationTuple
+from ..relationtuple.definitions import (
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    subject_from_dict,
+)
 from ..utils.errors import (
     DeadlineExceeded,
     ErrMalformedInput,
@@ -490,6 +495,121 @@ class ReadServicer:
             _abort(context, e)
 
 
+class ListServicer:
+    """keto_tpu extension: reverse-index list serving over gRPC.
+
+    The checked-in protos predate the list surface, so — like
+    BatchCheckEncoded — both methods are registered with identity
+    serializers and speak compact JSON bytes: the request mirrors the
+    REST query params ({"namespace", "relation", "subject_id" |
+    "subject_set": {...}, "max_depth", "page_size", "page_token",
+    "snaptoken", "latest"}), the response the REST body ({"objects" |
+    "subject_ids": [...], "next_page_token", "snaptoken"})."""
+
+    def __init__(
+        self,
+        list_engine,
+        snaptoken_fn: Callable[[], str],
+        version_waiter=None,
+        max_freshness_wait_s=30.0,
+        telemetry=None,
+    ):
+        self.list_engine = list_engine
+        self.snaptoken_fn = snaptoken_fn
+        self.version_waiter = version_waiter
+        self._freshness_cap = max_freshness_wait_s
+        self.telemetry = telemetry or NOOP_CHECK_TELEMETRY
+
+    def _decode(self, request: bytes) -> dict:
+        try:
+            body = json.loads(bytes(request) or b"{}")
+        except Exception as e:
+            raise ErrMalformedInput(f"malformed list request: {e}") from e
+        if not isinstance(body, dict):
+            raise ErrMalformedInput("expected a json list-request object")
+        return body
+
+    def _gate(self, body: dict, context) -> Optional[float]:
+        """Snaptoken freshness + the call deadline (absolute monotonic)."""
+        min_version = min_version_from(
+            body.get("snaptoken", ""), body.get("latest", "")
+        )
+        cap = self._freshness_cap
+        cap = float(cap()) if callable(cap) else float(cap)
+        remaining = context.time_remaining()
+        timeout = cap if remaining is None else min(remaining, cap)
+        _await_freshness(self.version_waiter, min_version, timeout)
+        return None if remaining is None else time.monotonic() + remaining
+
+    def _serve(self, request, context, items_key: str, run) -> bytes:
+        try:
+            body = self._decode(request)
+            deadline = self._gate(body, context)
+            traceparent, hedge = _trace_from_metadata(context)
+            with self.telemetry.record_check(
+                "grpc_list", deadline=deadline,
+                detail={"namespace": body.get("namespace", "")},
+                traceparent=traceparent, hedge=hedge,
+            ) as rec:
+                page = run(body, deadline, rec)
+                resp = json.dumps(
+                    {
+                        items_key: page.items,
+                        "next_page_token": page.next_page_token,
+                        "snaptoken": self.snaptoken_fn(),
+                    },
+                    separators=(",", ":"),
+                ).encode()
+                rec.mark("serialize")
+            return resp
+        except Exception as e:
+            _abort(context, e)
+
+    def ListObjects(self, request, context):
+        def run(body, deadline, rec):
+            if body.get("subject_id") is not None:
+                subject = SubjectID(id=body["subject_id"])
+            elif body.get("subject_set") is not None:
+                subject = subject_from_dict(body["subject_set"])
+            else:
+                raise ErrMalformedInput(
+                    "either subject_id or subject_set is required"
+                )
+            for key in ("namespace", "relation"):
+                if body.get(key) is None:
+                    raise ErrMalformedInput(f"missing field {key}")
+            return self.list_engine.list_objects(
+                subject=subject,
+                relation=body["relation"],
+                namespace=body["namespace"],
+                max_depth=int(body.get("max_depth", 0) or 0),
+                page_size=int(body.get("page_size", 0) or 0),
+                page_token=body.get("page_token", ""),
+                deadline=deadline,
+                rec=rec,
+            )
+
+        return self._serve(request, context, "objects", run)
+
+    def ListSubjects(self, request, context):
+        def run(body, deadline, rec):
+            for key in ("namespace", "object", "relation"):
+                if body.get(key) is None:
+                    raise ErrMalformedInput(f"missing field {key}")
+            return self.list_engine.list_subjects(
+                namespace=body["namespace"],
+                object=body["object"],
+                relation=body["relation"],
+                max_depth=int(body.get("max_depth", 0) or 0),
+                page_size=int(body.get("page_size", 0) or 0),
+                page_token=body.get("page_token", ""),
+                deadline=deadline,
+                rec=rec,
+            )
+
+        return self._serve(request, context, "subject_ids", run)
+
+
 class WriteServicer:
     def __init__(
         self,
@@ -668,6 +788,24 @@ def add_read_service(server, servicer: ReadServicer):
     ))
 
 
+def add_list_service(server, servicer: ListServicer):
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            f"{_PKG}.ListService",
+            {
+                # identity serializers: compact JSON bytes both ways (the
+                # checked-in protos predate the list surface)
+                "ListObjects": grpc.unary_unary_rpc_method_handler(
+                    servicer.ListObjects
+                ),
+                "ListSubjects": grpc.unary_unary_rpc_method_handler(
+                    servicer.ListSubjects
+                ),
+            },
+        ),
+    ))
+
+
 def add_write_service(server, servicer: WriteServicer):
     server.add_generic_rpc_handlers((
         grpc.method_handlers_generic_handler(
@@ -763,6 +901,17 @@ class ReadServiceStub:
             f"/{_PKG}.ReadService/ListRelationTuples",
             request_serializer=read_service_pb2.ListRelationTuplesRequest.SerializeToString,
             response_deserializer=read_service_pb2.ListRelationTuplesResponse.FromString,
+        )
+
+
+class ListServiceStub:
+    def __init__(self, channel: grpc.Channel):
+        # raw-bytes methods (JSON frames); no serializers on purpose
+        self.ListObjects = channel.unary_unary(
+            f"/{_PKG}.ListService/ListObjects"
+        )
+        self.ListSubjects = channel.unary_unary(
+            f"/{_PKG}.ListService/ListSubjects"
         )
 
 

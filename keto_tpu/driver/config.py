@@ -89,6 +89,10 @@ _PORT_SCHEMA = {
         # read plane only: serve the id-native wire tier (encoded
         # BatchCheck + /vocab bootstrap/delta feed, api/encoded.py)
         "encoded": {"type": "boolean"},
+        # read plane only: serve the reverse-index list routes
+        # (/relation-tuples/list-{objects,subjects} + the gRPC
+        # ListService, engine/listing.py)
+        "list": {"type": "boolean"},
         # read plane only: SO_REUSEPORT accept/parse worker processes for
         # the encoded path, funneling into one device batcher over the
         # shm ring (engine/shmring.py); rides the fork replica pool
@@ -302,6 +306,12 @@ CONFIG_SCHEMA = {
                     },
                     "additionalProperties": False,
                 },
+                # reverse closure index (engine/closure.py + graph/
+                # reverse.py): keep the transposed closure D^T + reverse
+                # boundary CSRs resident next to D so list queries are
+                # masked row gathers instead of per-candidate check scans.
+                # Off -> list routes answer from the exact (slow) oracle
+                "reverse_index": {"type": "boolean"},
                 # closure-build math (engine/closure.py): semiring =
                 # masked-SpMV batched BFS with incremental dirty-row
                 # rebuilds; matmul = the legacy dense-cube builder; auto
@@ -567,6 +577,7 @@ DEFAULTS = {
     "serve.read.grpc-max-message-size": 64 << 20,
     "serve.read.max_freshness_wait_s": 30.0,
     "serve.read.encoded": True,
+    "serve.read.list": True,
     "serve.read.wire_workers": 1,
     "serve.write.port": 4467,
     "serve.write.host": "",
@@ -592,6 +603,7 @@ DEFAULTS = {
     "engine.fallback": True,
     "engine.fallback_threshold": 3,
     "engine.fallback_cooldown_ms": 1000,
+    "engine.reverse_index": True,
     "engine.closure_builder": "auto",
     "engine.closure_block_workers": 0,
     "engine.expand_page_size": 0,

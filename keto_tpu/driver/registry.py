@@ -359,6 +359,9 @@ class Registry:
         # shared-memory ring funneling worker-process batches into this
         # process's single batcher
         self._encoded_front = None
+        # reverse-index list serving (engine/listing.py), None until
+        # list_engine() builds it (or serve.read.list is off)
+        self._list_engine = None
         self._wire_ring = None
         self._wire_ring_client = None  # set in forked wire workers only
         self._ring_server = None
@@ -1202,6 +1205,47 @@ class Registry:
             )
         return self._encoded_front
 
+    def list_engine(self):
+        """Reverse-index list serving (engine/listing.ListEngine) over the
+        closure engine's residency. None when serve.read.list is off or
+        the check engine has no reverse artifacts (host oracle, device
+        engines without a resident closure) — the list routes are then
+        not registered at all. engine.reverse_index=false keeps the
+        routes up but pins them to the exact oracle path."""
+        if self._list_engine is None:
+            if not bool(self.config.get("serve.read.list", default=True)):
+                return None
+            engine = self.check_engine()
+            if not hasattr(engine, "reverse_artifacts"):
+                return None
+            engine.reverse_enabled = bool(
+                self.config.get("engine.reverse_index", default=True)
+            )
+            hbm = self.hbm_admission()
+            if hbm is not None:
+                # per-snapshot D^T footprint feeds the admission model's
+                # resident floor, next to the shard residencies
+                engine.reverse_residency_cb = hbm.set_reverse_residency
+            from ..engine.listing import ListEngine
+
+            self._list_engine = ListEngine(
+                engine,
+                default_page_size=int(
+                    self.config.get("engine.expand_page_size", default=0)
+                ),
+                breaker_threshold=int(
+                    self.config.get("engine.fallback_threshold", default=3)
+                ),
+                breaker_cooldown_s=float(
+                    self.config.get(
+                        "engine.fallback_cooldown_ms", default=1000
+                    )
+                )
+                / 1e3,
+                logger=self.logger(),
+            )
+        return self._list_engine
+
     def _ring_handler(self, frame: bytes) -> bytes:
         """Parent-side wire-ring consumer: one encoded frame from a
         worker process -> the single batcher -> response frame. The
@@ -1734,6 +1778,7 @@ class Registry:
                 telemetry=self.check_telemetry(),
                 version_waiter=self.version_waiter(),
                 encoded_front=self.encoded_front(),
+                list_engine=self.list_engine(),
             )
             app = build_read_app(
                 self.store(),
@@ -1752,6 +1797,7 @@ class Registry:
                 max_freshness_wait_s=self._freshness_cap_s,
                 cluster_status_fn=self._cluster_status_fn(),
                 encoded_front=self.encoded_front(),
+                list_engine=self.list_engine(),
             )
             self._read_plane = PlaneServer(
                 grpc_server,

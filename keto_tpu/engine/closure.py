@@ -151,10 +151,20 @@ class _ClosureArtifacts:
         host: bool,
         d=None,
         d_host: Optional[np.ndarray] = None,
+        d_rev: Optional[np.ndarray] = None,
     ):
         self.snap = snap
         self.ig = ig
         self.k_max = k_max
+        # reverse residency (lazy, list-serving path): the transposed
+        # closure D^T plus the reverse boundary CSRs (graph/reverse.py).
+        # Built on first list query — closure builds pay nothing when the
+        # deployment never lists — except when an incremental build can
+        # carry the previous snapshot's D^T forward by re-gathering only
+        # the dirty columns.
+        self.d_rev = d_rev
+        self.rev = None
+        self.rev_lock = threading.Lock()
         # pad past the live interior: at least one INF row (the PAD index
         # target) plus real headroom the write overlay can grow new
         # interior nodes into without forcing a rebuild (engine/overlay.py
@@ -286,6 +296,15 @@ class ClosureCheckEngine:
 
             self._delta_cb = _cb
             subscribe(_cb)
+        # reverse-closure residency for the list-serving path: D^T + the
+        # reverse boundary CSRs, built lazily by _ensure_reverse on the
+        # first list query (engine/listing.py). The registry flips
+        # reverse_enabled from engine.reverse_index and points
+        # reverse_residency_cb at HbmAdmission.set_reverse_residency so a
+        # device-resident D^T is charged against headroom like shards.
+        self.reverse_enabled = True
+        self.reverse_residency_cb = None  # callable(bytes) or None
+        self.last_reverse_build_s = 0.0
         # build telemetry (read by tests and the metrics endpoint)
         self.n_full_builds = 0
         self.n_incremental_builds = 0
@@ -357,6 +376,67 @@ class ClosureCheckEngine:
                 self.snapshots.store, max_depth=self.global_max_depth
             )
         return self._fallback
+
+    # -- reverse residency (list serving) --------------------------------------
+
+    def reverse_artifacts(self) -> Optional[_ClosureArtifacts]:
+        """The snapshot artifacts with reverse residency (D^T + the reverse
+        boundary CSRs) attached, for the list-serving path — or None when
+        the reverse path cannot answer exactly right now:
+
+        - reverse serving disabled (engine.reverse_index=false), or
+        - no resident closure (too-big/fallback state).
+
+        A pinned write overlay (in-place D corrections for post-snapshot
+        writes) is NOT a decline: the reverse boundary CSRs are
+        snapshot-time, so the overlay's boundary deltas are folded in by
+        forcing a rebuild here — incremental (dirty-row + D^T carry) in
+        the common case, so list traffic pays the delta's blast radius,
+        not a full build. Callers (engine/listing.py) answer from the
+        live-store oracle in the None cases — slower, always exact."""
+        if not self.reverse_enabled:
+            return None
+        state, pinned = self._serving_pinned()
+        if pinned is not None:
+            self._build_sync()
+            state, pinned = self._serving_pinned()
+        if pinned is not None or not isinstance(state, _ClosureArtifacts):
+            return None
+        return self._ensure_reverse(state)
+
+    def _ensure_reverse(self, art: _ClosureArtifacts) -> _ClosureArtifacts:
+        """Build (or finish) `art`'s reverse residency, lazily on the first
+        list query against the snapshot: closure builds pay nothing when a
+        deployment never lists. Incremental builds that carried D^T forward
+        (dirty-column re-gather / per-edge transpose relax) skip the full
+        re-transpose here."""
+        with art.rev_lock:
+            if art.rev is not None and art.d_rev is not None:
+                return art
+            from ..graph.reverse import build_reverse
+            from .semiring import transpose_closure
+
+            t0 = time.perf_counter()
+            if art.rev is None:
+                art.rev = build_reverse(art.snap, art.ig)
+            if art.d_rev is None:
+                if art.d_host is not None:
+                    art.d_rev = transpose_closure(art.d_host)
+                else:
+                    # device residency: D^T lives next to D on the chip —
+                    # one materialized transpose, gathers stay on device
+                    art.d_rev = jnp.transpose(art.d).block_until_ready()
+            self.last_reverse_build_s = round(time.perf_counter() - t0, 6)
+            if art.d_host is None:
+                # only device-resident D^T counts against HBM admission;
+                # the host transpose and CSRs live in ordinary RAM
+                cb = self.reverse_residency_cb
+                if cb is not None:
+                    try:
+                        cb(int(art.d_rev.nbytes))
+                    except Exception:
+                        pass
+            return art
 
     def served_version(self) -> int:
         """The store version checks are currently answered at. Equals the
@@ -685,13 +765,13 @@ class ClosureCheckEngine:
         """Dirty-row closure update for an arbitrary interior edge delta
         (engine/semiring.py): reverse-BFS the blast radius from the
         changed edges, re-BFS only those rows on the new adjacency."""
-        from .semiring import update_closure_bitset
+        from .semiring import update_closure_bitset_ex, update_transpose
 
         t0 = time.perf_counter()
         blocks = interior_blocks(prev.ig)
         phases["blocks"] = round(time.perf_counter() - t0, 6)
         t0 = time.perf_counter()
-        d_host, n_dirty = update_closure_bitset(
+        d_host, rows = update_closure_bitset_ex(
             prev.d_host,
             prev.ig.ii_src,
             prev.ig.ii_dst,
@@ -708,11 +788,23 @@ class ClosureCheckEngine:
         phases["incremental"] = kernel_s
         self.n_incremental_builds += 1
         span.set_attr("kind", "incremental")
-        span.set_attr("dirty_rows", n_dirty)
+        span.set_attr("dirty_rows", int(rows.size))
         if self._m_builds is not None:
             self._m_builds.labels(kind="incremental").inc()
+        # carry the reverse index: the dirty rows of D are exactly the
+        # dirty COLUMNS of D^T, so the transpose updates by re-gathering
+        # only those (vs a full O(m_pad^2) re-transpose). Sound because
+        # prev.d_rev is ALWAYS prev.d_host's exact transpose — the write
+        # overlay mirrors its in-place D patches onto it (overlay.py).
+        d_rev = None
+        if prev.d_rev is not None:
+            t0 = time.perf_counter()
+            d_rev = update_transpose(prev.d_rev, d_host, rows)
+            phases["reverse_incremental"] = round(
+                time.perf_counter() - t0, 6
+            )
         return _ClosureArtifacts(
-            snap, ig, k_max, host=True, d_host=d_host
+            snap, ig, k_max, host=True, d_host=d_host, d_rev=d_rev
         )
 
     @staticmethod
@@ -754,12 +846,22 @@ class ClosureCheckEngine:
         rebuilt vectorized by build_interior (O(E)); only D carries over."""
         if host:
             d_host = prev.d_host
+            # carry D^T alongside: inserting edge (u, v) into D is the same
+            # relax as inserting (v, u) into D^T, so the per-edge kernel
+            # maintains the transpose directly — no re-transpose at all.
+            d_rev = prev.d_rev
             if len(new_ii):
                 d_host = d_host.copy()
+                if d_rev is not None:
+                    d_rev = d_rev.copy()
                 for u, v in new_ii:
                     closure_insert_edge_host(d_host, int(u), int(v), k_max)
+                    if d_rev is not None:
+                        closure_insert_edge_host(
+                            d_rev, int(v), int(u), k_max
+                        )
             return _ClosureArtifacts(
-                snap, ig, k_max, host=True, d_host=d_host
+                snap, ig, k_max, host=True, d_host=d_host, d_rev=d_rev
             )
         d = prev.d
         for u, v in new_ii:

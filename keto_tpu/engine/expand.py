@@ -28,8 +28,6 @@ cursor names nodes that may no longer exist).
 
 from __future__ import annotations
 
-import base64
-import json
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -40,6 +38,7 @@ from ..relationtuple.definitions import (
     SubjectSet,
 )
 from ..utils.errors import ErrMalformedPageToken, ErrNotFound
+from .paging import decode_page_token, encode_page_token
 from ..utils.pagination import PaginationOptions
 from .check import DEFAULT_MAX_DEPTH, clamp_depth
 from .tree import NodeType, Tree
@@ -78,47 +77,33 @@ class ExpandPage:
 
 
 def encode_expand_page_token(kind: str, version, pending, visited) -> str:
-    """Continuation cursor: base64url(json) of the deferred work items (in
-    DFS-preorder resume order), the visited set, and the data version the
-    page was cut at."""
-    payload = {
-        "k": kind,
-        "v": version,
-        "p": [[list(path), ref, rest] for path, ref, rest in pending],
-        "vis": visited,
-    }
-    raw = json.dumps(payload, separators=(",", ":")).encode()
-    return base64.urlsafe_b64encode(raw).decode()
+    """Continuation cursor: the deferred work items (in DFS-preorder resume
+    order), the visited set, and the data version the page was cut at —
+    minted through the shared engine/paging.py format."""
+    return encode_page_token(
+        kind,
+        version,
+        {
+            "p": [[list(path), ref, rest] for path, ref, rest in pending],
+            "vis": visited,
+        },
+    )
 
 
 def decode_expand_page_token(token: str, kind: str, version):
-    """-> (pending, visited). Raises ErrMalformedPageToken on garbage, a
-    cursor from the other engine flavor, or a version mismatch (the
-    snapshot the cursor walked has been superseded)."""
+    """-> (pending, visited). Raises ErrMalformedPageToken on garbage or a
+    cursor from the other engine flavor, ErrStalePageToken (a 409 subclass
+    of it) on a version mismatch (the snapshot the cursor walked has been
+    superseded)."""
+    payload = decode_page_token(token, kind, version, what="expand page")
     try:
-        payload = json.loads(base64.urlsafe_b64decode(token.encode()))
-        got_kind = payload["k"]
-        got_version = payload["v"]
         pending = [
             (list(path), ref, int(rest))
             for path, ref, rest in payload["p"]
         ]
         visited = payload["vis"]
-    except ErrMalformedPageToken:
-        raise
     except Exception as e:
-        raise ErrMalformedPageToken(
-            "malformed expand page token"
-        ) from e
-    if got_kind != kind:
-        raise ErrMalformedPageToken(
-            f"expand page token was issued by a {got_kind!r} engine"
-        )
-    if got_version != version:
-        raise ErrMalformedPageToken(
-            f"expand page token expired: issued at version {got_version}, "
-            f"serving {version}"
-        )
+        raise ErrMalformedPageToken("malformed expand page token") from e
     return pending, visited
 
 

@@ -82,6 +82,9 @@ class HbmAdmission:
         # (D replica + CSR stripes); admission subtracts the fullest
         # shard's residency from the budget
         self._shard_residency: dict[int, float] = {}
+        # device-resident reverse closure D^T (list serving); stacks on
+        # the shard floor — see _resident_floor_locked
+        self._reverse_residency = 0.0
         # token -> (modeled cost, shape key, per-device peak samples at
         # reserve time — None when no device reports memory stats)
         self._inflight: dict[
@@ -226,8 +229,22 @@ class HbmAdmission:
             }
             self._headroom_wake.notify_all()
 
+    def set_reverse_residency(self, nbytes: float) -> None:
+        """The closure engine reports the device-resident reverse closure
+        D^T (engine/closure.py _ensure_reverse) — per-snapshot footprint
+        learned the same way as shard residency; 0 drops the charge."""
+        with self._lock:
+            self._reverse_residency = max(0.0, float(nbytes))
+            self._headroom_wake.notify_all()
+
     def _resident_floor_locked(self) -> float:
-        return max(self._shard_residency.values(), default=0.0)
+        # shard residencies are per-device alternatives (the fullest shard
+        # OOMs first); the reverse closure is pinned on EVERY serving
+        # device next to D, so it stacks on top of that floor
+        return (
+            max(self._shard_residency.values(), default=0.0)
+            + self._reverse_residency
+        )
 
     def clamp_rows(self, rows: int) -> int:
         """Largest batch (<= ``rows``) whose modeled footprint fits the
@@ -340,6 +357,7 @@ class HbmAdmission:
                 "bytes_per_row": round(self._bytes_per_row, 1),
                 "modeled_shapes": len(self._model),
                 "shard_residency": dict(self._shard_residency),
+                "reverse_residency_bytes": self._reverse_residency,
                 "resident_floor_bytes": self._resident_floor_locked(),
                 "modeled_shard_shapes": len(self._shard_model),
             }

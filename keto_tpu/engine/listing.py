@@ -1,0 +1,484 @@
+"""List serving: reverse-closure answers for "what can this subject see?"
+
+Check answers one (object#relation, subject) edge of the ACL matrix; the
+other two production queries walk a whole row or column of it:
+
+- ``list_objects(subject, relation, namespace)``  — every object the
+  subject holds ``relation`` on (the "my documents" query);
+- ``list_subjects(namespace, object, relation)``  — every subject id the
+  object's relation resolves to (the audit query).
+
+The brute-force shape is a check per candidate — at rbac1m that is ~100k
+oracle BFS walks per list request. This engine answers both directions
+with gathers against the *reverse* closure residency instead
+(engine/closure.py ``reverse_artifacts``): the transposed closure ``D^T``
+plus the reverse boundary CSRs (graph/reverse.py). The check
+decomposition (graph/interior.py) factors every path as
+
+    start -> s (boundary in) ~~> s' (interior, D) -> target (boundary out)
+
+so fixing the *target* and asking "which starts?" is one masked row gather:
+
+- ``list_objects``, subject-id target T: qualifying interior nodes are
+  ``min over s' in L(T) of D[s, s'] <= depth - 2`` — an elementwise min of
+  the ``D^T`` rows at ``L(T)``; candidates are their ``set_in`` preimages
+  plus T's direct predecessors.
+- ``list_objects``, subject-set target: one ``D^T`` row at the target's
+  interior index, threshold ``depth - 1``.
+- ``list_subjects`` from set S: min of the forward ``D`` rows at ``F0(S)``,
+  threshold ``depth - 2``; answers are the ``id_out`` images plus S's
+  direct id successors.
+
+Results are exactly the forward formula's fixpoint — tests/test_listing.py
+holds the engine byte-identical to the per-candidate oracle.
+
+Serving shape mirrors the check pipeline: encode (resolve the query to
+node ids, pick the serving residency) -> gather (the D^T row math) ->
+decode (node ids -> sorted strings, page slice), with the caller's
+deadline checked at every stage boundary and TimeLedger attribution under
+the same stage names. When the reverse path cannot answer exactly — no
+resident closure, a pinned write overlay correcting D in place, reverse
+serving disabled, or a gather failure (fault site ``list.gather_fail``) —
+requests escalate to the live-store oracle, which is always exact; a run
+of consecutive gather failures opens a breaker that pins the oracle for a
+cooldown before re-probing the reverse path.
+
+Pages ride the shared continuation-token machinery (engine/paging.py):
+tokens pin the data version they were cut at (stale -> 409
+``ErrStalePageToken``), echo the query (cross-query reuse -> 400), and a
+token minted by the expand engine fails typed here (kind mismatch).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..faults import FAULTS
+from ..relationtuple.definitions import (
+    RelationQuery,
+    RelationTuple,
+    Subject,
+    SubjectID,
+)
+from ..utils.errors import (
+    DeadlineExceeded,
+    ErrMalformedPageToken,
+    KetoError,
+)
+from ..utils.pagination import PaginationOptions
+from .check import clamp_depth
+from .paging import decode_page_token, encode_page_token
+
+#: consecutive reverse-path failures before the breaker pins the oracle
+_BREAKER_THRESHOLD = 3
+#: seconds the open breaker serves from the oracle before re-probing
+_BREAKER_COOLDOWN_S = 30.0
+#: oracle candidate loops re-check the caller's deadline this often
+_DEADLINE_STRIDE = 256
+
+
+@dataclass
+class ListPage:
+    """One page of a list query. ``items`` are object names
+    (``list_objects``) or subject-id strings (``list_subjects``), sorted;
+    ``version`` is the store version the page was computed at (what the
+    snaptoken names); ``source`` records which path answered ("reverse"
+    or "oracle") — diagnostics, never part of the wire contract."""
+
+    items: list = field(default_factory=list)
+    next_page_token: str = ""
+    version: int = 0
+    source: str = "reverse"
+
+
+def _csr_row(indptr: np.ndarray, vals: np.ndarray, row: int) -> np.ndarray:
+    return vals[indptr[row] : indptr[row + 1]]
+
+
+def _csr_rows_concat(
+    indptr: np.ndarray, vals: np.ndarray, rows: np.ndarray
+) -> np.ndarray:
+    """Concatenate many CSR rows (the ``set_in``/``id_out`` preimage of
+    every qualifying interior node). Python loop over qualifying rows
+    only; each copy is a vectorized slice."""
+    if rows.size == 0:
+        return np.empty(0, dtype=np.int32)
+    counts = indptr[rows + 1] - indptr[rows]
+    out = np.empty(int(counts.sum()), dtype=np.int32)
+    pos = 0
+    for r, c in zip(rows.tolist(), counts.tolist()):
+        out[pos : pos + c] = vals[indptr[r] : indptr[r] + c]
+        pos += c
+    return out
+
+
+def _rows_min(mat, rows: np.ndarray) -> np.ndarray:
+    """Elementwise min over a set of matrix rows — host numpy or a
+    device-resident closure (one jit'd take+reduce, small transfer)."""
+    if isinstance(mat, np.ndarray):
+        return mat[rows].min(axis=0)
+    import jax.numpy as jnp
+
+    return np.asarray(
+        jnp.min(jnp.take(mat, jnp.asarray(rows), axis=0), axis=0)
+    )
+
+
+class ListEngine:
+    """Reverse-index list serving over a ClosureCheckEngine's residency.
+
+    Thread-safe for concurrent list calls (the gathers are read-only; the
+    breaker fields are guarded). The engine never answers inexactly: every
+    path that cannot guarantee the forward fixpoint escalates to the
+    live-store oracle.
+    """
+
+    def __init__(
+        self,
+        engine,
+        default_page_size: int = 0,
+        breaker_threshold: int = _BREAKER_THRESHOLD,
+        breaker_cooldown_s: float = _BREAKER_COOLDOWN_S,
+        logger=None,
+        clock=time.monotonic,
+    ):
+        self.engine = engine
+        self.default_page_size = default_page_size
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self.logger = logger
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._fail_streak = 0
+        self._open_until = 0.0
+        # served-request counters (tests, /debug readers)
+        self.n_reverse = 0
+        self.n_oracle = 0
+        self.n_reverse_failures = 0
+
+    # -- breaker ---------------------------------------------------------------
+
+    def breaker_open(self) -> bool:
+        with self._lock:
+            return self._clock() < self._open_until
+
+    def _note_reverse_ok(self) -> None:
+        with self._lock:
+            self._fail_streak = 0
+            self.n_reverse += 1
+
+    def _note_reverse_failure(self, exc: Exception) -> None:
+        with self._lock:
+            self.n_reverse_failures += 1
+            self._fail_streak += 1
+            opened = self._fail_streak >= self.breaker_threshold
+            if opened:
+                self._open_until = self._clock() + self.breaker_cooldown_s
+                self._fail_streak = 0
+        if self.logger is not None:
+            self.logger.warn(
+                "list reverse path failed; answering from the oracle",
+                error=str(exc),
+                breaker_opened=opened,
+            )
+
+    # -- public API ------------------------------------------------------------
+
+    def list_objects(
+        self,
+        subject: Subject,
+        relation: str,
+        namespace: str,
+        max_depth: int = 0,
+        page_size: int = 0,
+        page_token: str = "",
+        deadline: Optional[float] = None,
+        rec=None,
+    ) -> ListPage:
+        depth = clamp_depth(max_depth, self.engine.global_max_depth)
+        query = ["objects", namespace, relation, str(subject), depth]
+        return self._serve(
+            query,
+            lambda art: self._reverse_list_objects(
+                art, subject, relation, namespace, depth
+            ),
+            lambda: self._oracle_list_objects(
+                subject, relation, namespace, depth, deadline
+            ),
+            page_size,
+            page_token,
+            deadline,
+            rec,
+        )
+
+    def list_subjects(
+        self,
+        namespace: str,
+        object: str,
+        relation: str,
+        max_depth: int = 0,
+        page_size: int = 0,
+        page_token: str = "",
+        deadline: Optional[float] = None,
+        rec=None,
+    ) -> ListPage:
+        depth = clamp_depth(max_depth, self.engine.global_max_depth)
+        query = ["subjects", namespace, object, relation, depth]
+        return self._serve(
+            query,
+            lambda art: self._reverse_list_subjects(
+                art, namespace, object, relation, depth
+            ),
+            lambda: self._oracle_list_subjects(
+                namespace, object, relation, depth, deadline
+            ),
+            page_size,
+            page_token,
+            deadline,
+            rec,
+        )
+
+    # -- the encode -> gather -> decode spine ----------------------------------
+
+    def _serve(
+        self,
+        query: list,
+        reverse_fn,
+        oracle_fn,
+        page_size: int,
+        page_token: str,
+        deadline: Optional[float],
+        rec,
+    ) -> ListPage:
+        # encode: pick the serving residency. reverse_artifacts() returns
+        # None whenever the reverse path could be inexact (no resident
+        # closure / pinned overlay / disabled) — those requests answer
+        # from the oracle without touching the breaker.
+        self._check_deadline(deadline)
+        art = None
+        if not self.breaker_open():
+            art = self.engine.reverse_artifacts()
+        if rec is not None:
+            rec.mark("encode")
+
+        # gather: the full sorted result set. Recomputed per page —
+        # slicing a deterministic sorted list is what makes paged ==
+        # unpaged byte-identical, and the version pin below 409s the
+        # moment a write would have made two pages disagree.
+        source = "reverse"
+        items: Optional[list] = None
+        if art is not None:
+            try:
+                self._check_deadline(deadline)
+                items = reverse_fn(art)
+                self._note_reverse_ok()
+            except KetoError:
+                raise  # deadline/typed errors are the caller's, not a path failure
+            except Exception as e:  # noqa: BLE001 — breaker seam
+                self._note_reverse_failure(e)
+                items = None
+        if items is None:
+            source = "oracle"
+            self._check_deadline(deadline)
+            items = oracle_fn()
+            with self._lock:
+                self.n_oracle += 1
+        version = (
+            art.version
+            if source == "reverse"
+            else self.engine.snapshots.store.version
+        )
+        if rec is not None:
+            rec.mark("launch")
+
+        # decode: validate the cursor against the version that actually
+        # answered, slice, mint the continuation
+        offset = self._decode_list_token(page_token, query, version)
+        self._check_deadline(deadline)
+        if page_size <= 0:
+            page_size = self.default_page_size
+        next_token = ""
+        if page_size > 0:
+            end = offset + page_size
+            if end < len(items):
+                next_token = encode_page_token(
+                    "list", version, {"q": query, "o": end}
+                )
+            items = items[offset:end]
+        elif offset:
+            items = items[offset:]
+        if rec is not None:
+            rec.mark("decode")
+        return ListPage(
+            items=items,
+            next_page_token=next_token,
+            version=version,
+            source=source,
+        )
+
+    @staticmethod
+    def _check_deadline(deadline: Optional[float]) -> None:
+        if deadline is not None and time.monotonic() > deadline:
+            raise DeadlineExceeded()
+
+    @staticmethod
+    def _decode_list_token(token: str, query: list, version) -> int:
+        if not token:
+            return 0
+        payload = decode_page_token(token, "list", version, what="list page")
+        try:
+            offset = int(payload["o"])
+            tq = payload["q"]
+        except Exception as e:
+            raise ErrMalformedPageToken("malformed list page token") from e
+        if tq != query or offset < 0:
+            raise ErrMalformedPageToken(
+                "list page token was minted for a different query"
+            )
+        return offset
+
+    # -- reverse gathers -------------------------------------------------------
+
+    def _reverse_list_objects(
+        self, art, subject, relation: str, namespace: str, depth: int
+    ) -> list:
+        FAULTS.fire("list.gather_fail")
+        snap, ig, rev = art.snap, art.ig, art.rev
+        t = snap.node_for_subject(subject)
+        cand: list[np.ndarray] = []
+        if depth >= 1:
+            cand.append(rev.direct_preds(t))
+        if depth >= 2:
+            t_int = int(ig.interior_index[t])
+            if t_int >= 0:
+                # set target: start -> s (1 edge) ~~> target (D[s, t]);
+                # one D^T row, threshold depth - 1
+                mins = _rows_min(
+                    art.d_rev, np.asarray([t_int], dtype=np.int64)
+                )[: ig.m]
+                qual = np.nonzero(mins <= depth - 1)[0]
+            else:
+                # id target: start -> s ~~> s' -> target, s' in L(target);
+                # elementwise min of the D^T rows at L, threshold depth - 2
+                l_idx = _csr_row(ig.id_in_indptr, ig.id_in_vals, t)
+                if l_idx.size:
+                    mins = _rows_min(art.d_rev, l_idx.astype(np.int64))[
+                        : ig.m
+                    ]
+                    qual = np.nonzero(mins <= depth - 2)[0]
+                else:
+                    qual = np.empty(0, dtype=np.int64)
+            cand.append(
+                _csr_rows_concat(rev.set_in_indptr, rev.set_in_vals, qual)
+            )
+        vocab = snap.vocab
+        out = set()
+        for nid in np.unique(np.concatenate(cand)) if cand else ():
+            k = vocab.key(int(nid))
+            if len(k) == 3 and k[0] == namespace and k[2] == relation:
+                out.add(k[1])
+        return sorted(out)
+
+    def _reverse_list_subjects(
+        self, art, namespace: str, object: str, relation: str, depth: int
+    ) -> list:
+        FAULTS.fire("list.gather_fail")
+        snap, ig, rev = art.snap, art.ig, art.rev
+        s = snap.node_for_set(namespace, object, relation)
+        cand: list[np.ndarray] = []
+        if depth >= 1:
+            cand.append(snap.out_neighbors(s))
+        if depth >= 2:
+            f0 = _csr_row(ig.set_out_indptr, ig.set_out_vals, s)
+            if f0.size:
+                # start -> s (1) ~~> s' (D) -> id (1): forward D rows at
+                # F0(start), threshold depth - 2
+                fwd = art.d_host if art.d_host is not None else art.d
+                mins = _rows_min(fwd, f0.astype(np.int64))[: ig.m]
+                qual = np.nonzero(mins <= depth - 2)[0]
+                cand.append(
+                    _csr_rows_concat(
+                        rev.id_out_indptr, rev.id_out_vals, qual
+                    )
+                )
+        vocab = snap.vocab
+        out = set()
+        for nid in np.unique(np.concatenate(cand)) if cand else ():
+            k = vocab.key(int(nid))
+            if len(k) == 1:
+                out.add(k[0])
+        return sorted(out)
+
+    # -- the live-store oracle -------------------------------------------------
+    #
+    # Candidate universes match the reverse path exactly: a qualifying
+    # object must have at least one (ns, obj, rel) tuple (a path out of
+    # its set node), a qualifying subject id must appear as some tuple's
+    # subject (a path into its node). Each candidate is then settled by
+    # the exact fallback check engine over the live store.
+
+    def _scan_tuples(self, query: RelationQuery, deadline):
+        mgr = self.engine.snapshots.store
+        token = ""
+        while True:
+            self._check_deadline(deadline)
+            page, token = mgr.get_relation_tuples(
+                query, PaginationOptions(token=token)
+            )
+            yield from page
+            if not token:
+                return
+
+    def _oracle_list_objects(
+        self, subject, relation: str, namespace: str, depth: int, deadline
+    ) -> list:
+        objects = set()
+        for t in self._scan_tuples(
+            RelationQuery(namespace=namespace, relation=relation), deadline
+        ):
+            objects.add(t.object)
+        fb = self.engine.fallback_engine()
+        out = []
+        for i, o in enumerate(sorted(objects)):
+            if i % _DEADLINE_STRIDE == 0:
+                self._check_deadline(deadline)
+            if fb.subject_is_allowed(
+                RelationTuple(
+                    namespace=namespace,
+                    object=o,
+                    relation=relation,
+                    subject=subject,
+                ),
+                depth,
+            ):
+                out.append(o)
+        return out
+
+    def _oracle_list_subjects(
+        self, namespace: str, object: str, relation: str, depth: int, deadline
+    ) -> list:
+        subjects = set()
+        for t in self._scan_tuples(RelationQuery(), deadline):
+            if isinstance(t.subject, SubjectID):
+                subjects.add(t.subject.id)
+        fb = self.engine.fallback_engine()
+        out = []
+        for i, sid in enumerate(sorted(subjects)):
+            if i % _DEADLINE_STRIDE == 0:
+                self._check_deadline(deadline)
+            if fb.subject_is_allowed(
+                RelationTuple(
+                    namespace=namespace,
+                    object=object,
+                    relation=relation,
+                    subject=SubjectID(id=sid),
+                ),
+                depth,
+            ):
+                out.append(sid)
+        return out

@@ -182,12 +182,24 @@ class WriteOverlay:
     # -- D access: host copy (numpy, in place) or device-resident (jax
     # arrays are immutable, so patches swap the reference atomically) ----------
 
+    # Every D patch is mirrored onto the reverse closure D^T when the
+    # list-serving path has materialized it (closure.py _ensure_reverse):
+    # the per-edge relax mirrors exactly by swapping the edge endpoints,
+    # a row store mirrors as a column store. Keeping d_rev == d_host.T at
+    # all times is what lets incremental rebuilds carry D^T forward
+    # instead of paying a full re-transpose per write burst. A
+    # device-resident d_rev is invalidated instead (rebuilt lazily from
+    # the patched device D, which jax's .at ops keep consistent).
+
     def _d_set_diag(self, idx: int) -> None:
         art = self.art
         if art.d_host is not None:
             art.d_host[idx, idx] = 0
+            if art.d_rev is not None:
+                art.d_rev[idx, idx] = 0
         else:
             art.d = art.d.at[idx, idx].set(0)
+            art.d_rev = None
 
     def _d_insert_edge(self, u: int, v: int) -> None:
         # record for the delete re-close's current-adjacency view
@@ -195,6 +207,8 @@ class WriteOverlay:
         art = self.art
         if art.d_host is not None:
             closure_insert_edge_host(art.d_host, u, v, art.k_max)
+            if art.d_rev is not None:
+                closure_insert_edge_host(art.d_rev, v, u, art.k_max)
         else:
             import jax.numpy as jnp
 
@@ -203,6 +217,7 @@ class WriteOverlay:
             art.d = closure_insert_edge(
                 art.d, jnp.int32(u), jnp.int32(v), jnp.int32(art.k_max)
             )
+            art.d_rev = None
 
     def _d_min(self, rows: np.ndarray, cols: np.ndarray) -> int:
         art = self.art
@@ -249,23 +264,29 @@ class WriteOverlay:
             # each (i,j) either pre- or post-delete — the same
             # between-versions guarantee the monotone insert path gives
             art.d_host[rows.astype(np.int64)] = vals
+            if art.d_rev is not None:
+                art.d_rev[:, rows.astype(np.int64)] = vals.T
         else:
             import jax.numpy as jnp
 
             art.d = art.d.at[jnp.asarray(rows, jnp.int32)].set(
                 jnp.asarray(vals)
             )
+            art.d_rev = None
 
     def _d_set_cols(self, cols: np.ndarray, vals: np.ndarray) -> None:
         art = self.art
         if art.d_host is not None:
             art.d_host[:, cols.astype(np.int64)] = vals
+            if art.d_rev is not None:
+                art.d_rev[cols.astype(np.int64), :] = vals.T
         else:
             import jax.numpy as jnp
 
             art.d = art.d.at[:, jnp.asarray(cols, jnp.int32)].set(
                 jnp.asarray(vals)
             )
+            art.d_rev = None
 
     # -- current interior adjacency (for the delete re-close) ------------------
 

@@ -270,11 +270,43 @@ def update_closure_bitset(
     (inserts and deletes). Returns (d_new, n_dirty_rows); d_prev is not
     mutated. Exact: dirty rows are recomputed from scratch on the new
     adjacency, clean rows are carried over."""
+    d, rows = update_closure_bitset_ex(
+        d_prev,
+        prev_src,
+        prev_dst,
+        new_src,
+        new_dst,
+        m,
+        m_pad,
+        k_max,
+        workers=workers,
+        blocks=blocks,
+    )
+    return d, int(rows.size)
+
+
+def update_closure_bitset_ex(
+    d_prev: np.ndarray,
+    prev_src: np.ndarray,
+    prev_dst: np.ndarray,
+    new_src: np.ndarray,
+    new_dst: np.ndarray,
+    m: int,
+    m_pad: int,
+    k_max: int,
+    *,
+    workers: int = 0,
+    blocks: Optional[InteriorBlocks] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """`update_closure_bitset` returning (d_new, dirty_rows int32[])
+    instead of a count — the rows are exactly the ones whose bytes may
+    differ, which is what an incremental transpose (update_transpose)
+    needs to re-gather only touched columns of D^T."""
     inserted, deleted = interior_edge_delta(
         prev_src, prev_dst, new_src, new_dst, m_pad
     )
     if inserted.size == 0 and deleted.size == 0:
-        return d_prev, 0
+        return d_prev, np.zeros(0, dtype=np.int32)
     rows = dirty_rows(
         inserted,
         deleted,
@@ -312,4 +344,26 @@ def update_closure_bitset(
         else:
             _bfs_rows_into(d, adj_packed, rows, m_pad, k_max)
         d[rows, rows] = 0  # dirty rows are live by construction
-    return d, int(rows.size)
+    return d, rows
+
+
+def transpose_closure(d: np.ndarray) -> np.ndarray:
+    """Full reverse index: D^T materialized contiguously. Row j of the
+    result is column j of D — every interior source within distance
+    D[i, j] of j, which is the gather a list_objects query needs."""
+    return np.ascontiguousarray(d.T)
+
+
+def update_transpose(
+    d_rev: np.ndarray, d_new: np.ndarray, rows: np.ndarray
+) -> np.ndarray:
+    """Incremental reverse maintenance: the dirty-row bitset update knows
+    exactly which rows of D changed, so only those COLUMNS of D^T are
+    re-gathered (a strided scatter over n_dirty columns) instead of
+    re-transposing the whole matrix. Returns a new array; d_rev is not
+    mutated (snapshots may still be serving it)."""
+    if rows.size == 0:
+        return d_rev
+    out = d_rev.copy()
+    out[:, rows] = d_new[rows, :].T
+    return out

@@ -78,6 +78,11 @@ site                      effect when armed
                           mesh dispatch; the breaker must answer the batch
                           from the host oracle and re-probe the mesh path
                           (parallel/serving.py + engine/fallback.py)
+``list.gather_fail``      a list-serving reverse-index gather raises before
+                          decoding candidates; the list breaker must answer
+                          from the live-store oracle with identical results
+                          and later re-probe the reverse path
+                          (engine/listing.py)
 ``election.split_heartbeat``  a follower loses one leader-liveness
                           observation and falsely suspects a live leader —
                           the premature candidacy must lose the lease CAS,

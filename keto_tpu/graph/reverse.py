@@ -1,0 +1,116 @@
+"""Reverse boundary CSRs for list queries — the D^T companion structures.
+
+The interior decomposition (graph/interior.py) is oriented for Check: given
+a start node it gathers F0 (set-successors) and given a target it gathers
+L (interior predecessors). List queries ask the opposite questions:
+
+- ``list_objects(subject)``: which *set nodes* reach the subject? After the
+  transposed closure ``D^T`` answers "which interior sources reach L(target)
+  within budget", two boundary hops remain:
+
+  * ``set_in``: interior index -> source *node ids* of edges into that set
+    (the reverse of F0). A qualifying interior s' is reachable from every
+    node with an edge into s' — those are the answer candidates one hop
+    out of the interior.
+  * ``in_csr``: node id -> source node ids over ALL edges (the depth-1
+    direct-edge predecessors; sources with no incoming edge are not
+    interior, so no interior walk finds them).
+
+- ``list_subjects(object#relation)``: which *subject ids* does a set reach?
+  ``id_out``: interior index -> subject-id node ids of edges out of that
+  set (the reverse of L), unioned with the start's own id out-neighbors
+  (depth 1, via the snapshot's forward CSR).
+
+Shapes are all int32 CSRs built with the same stable-argsort pass as the
+forward decomposition; ``residency_bytes`` is what the HBM admission model
+charges when the paired D^T lives on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .interior import InteriorGraph, _csr_by
+from .snapshot import GraphSnapshot
+
+
+@dataclass
+class ReverseIndex:
+    """Reverse boundary CSRs for one snapshot's interior decomposition."""
+
+    padded_nodes: int
+    m: int
+    # interior idx -> node ids with an edge INTO that interior set
+    set_in_indptr: np.ndarray  # int32[m + 1]
+    set_in_vals: np.ndarray  # int32[e_set]
+    # interior idx -> subject-id node ids that set points at directly
+    id_out_indptr: np.ndarray  # int32[m + 1]
+    id_out_vals: np.ndarray  # int32[e_id_interior]
+    # node id -> source node ids over ALL edges (direct predecessors)
+    in_indptr: np.ndarray  # int32[padded_nodes + 1]
+    in_vals: np.ndarray  # int32[e]
+
+    def residency_bytes(self) -> int:
+        """Host bytes of the CSRs themselves (D^T is charged separately)."""
+        return int(
+            self.set_in_indptr.nbytes
+            + self.set_in_vals.nbytes
+            + self.id_out_indptr.nbytes
+            + self.id_out_vals.nbytes
+            + self.in_indptr.nbytes
+            + self.in_vals.nbytes
+        )
+
+    def preds_of_interior(self, idx: int) -> np.ndarray:
+        """Node ids with an edge into interior index `idx`."""
+        return self.set_in_vals[
+            self.set_in_indptr[idx] : self.set_in_indptr[idx + 1]
+        ]
+
+    def ids_of_interior(self, idx: int) -> np.ndarray:
+        """Subject-id node ids interior index `idx` points at directly."""
+        return self.id_out_vals[
+            self.id_out_indptr[idx] : self.id_out_indptr[idx + 1]
+        ]
+
+    def direct_preds(self, nid: int) -> np.ndarray:
+        """Source node ids of all edges into `nid`."""
+        return self.in_vals[self.in_indptr[nid] : self.in_indptr[nid + 1]]
+
+
+def build_reverse(snap: GraphSnapshot, ig: InteriorGraph) -> ReverseIndex:
+    """Derive the reverse CSRs from the snapshot's COO edges — the same
+    vectorized passes as build_interior, grouped the other way."""
+    e = snap.num_edges
+    pn = snap.padded_nodes
+    src = snap.src[:e]
+    dst = snap.dst[:e]
+
+    dst_idx = ig.interior_index[dst]
+    dst_is_set = dst_idx >= 0  # interior == set-with-incoming == every dst set
+
+    m = max(ig.m, 1)  # _csr_by wants >= 1 group; m == 0 leaves empty vals
+    set_in_indptr, set_in_vals = _csr_by(
+        dst_idx[dst_is_set], src[dst_is_set], m
+    )
+
+    id_mask = ~dst_is_set
+    i_src_idx = ig.interior_index[src[id_mask]]
+    i_dst = dst[id_mask]
+    keep = i_src_idx >= 0
+    id_out_indptr, id_out_vals = _csr_by(i_src_idx[keep], i_dst[keep], m)
+
+    in_indptr, in_vals = _csr_by(dst, src, pn)
+
+    return ReverseIndex(
+        padded_nodes=pn,
+        m=ig.m,
+        set_in_indptr=set_in_indptr,
+        set_in_vals=set_in_vals.astype(np.int32),
+        id_out_indptr=id_out_indptr,
+        id_out_vals=id_out_vals.astype(np.int32),
+        in_indptr=in_indptr,
+        in_vals=in_vals.astype(np.int32),
+    )

@@ -69,6 +69,23 @@ class ErrMalformedPageToken(ErrMalformedInput):
         return "The provided page token is malformed."
 
 
+class ErrStalePageToken(ErrMalformedPageToken):
+    """A well-formed continuation token whose pinned data version has been
+    superseded (the store moved between pages). Distinct from a garbage
+    token: the client did nothing wrong — it raced a write — so the wire
+    mapping is 409/FAILED_PRECONDITION (restart the listing), not 400."""
+
+    status_code = 409
+    status = "Conflict"
+    grpc_code = "FAILED_PRECONDITION"
+
+    def default_message(self) -> str:
+        return (
+            "The page token was issued against a superseded data version; "
+            "restart the listing."
+        )
+
+
 class ErrInvalidTuple(ErrMalformedInput):
     def default_message(self) -> str:
         return "The provided relation tuple is invalid."

@@ -122,6 +122,8 @@ def test_openapi_spec_routes_cover_rest_surface():
         "/check/batch": {"post"},
         "/expand": {"get"},
         "/relation-tuples": {"get", "put", "delete", "patch"},
+        "/relation-tuples/list-objects": {"get"},
+        "/relation-tuples/list-subjects": {"get"},
         "/health/alive": {"get"},
         "/health/ready": {"get"},
         "/version": {"get"},

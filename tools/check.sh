@@ -46,10 +46,17 @@
 #      traffic, scrape /metrics from both planes in Prometheus-text and
 #      OpenMetrics formats, and fail on naming/duplicate-series/format
 #      violations
-#   7. closure microbench gate — tools/closure_microbench.py --gate:
+#   7. reverse-index parity — the fast core of tests/test_listing.py:
+#      list_objects/list_subjects answered from the transposed closure
+#      D^T byte-identical to the brute-force forward-scan oracle
+#      (random graphs, cycles, unicode, stale/cross-engine tokens) on
+#      both query modes, plus the gather-fault breaker drill
+#   8. closure microbench gate — tools/closure_microbench.py --gate:
 #      incremental closure update after one edge >= 5x faster than a
-#      full semiring rebuild (median-of-5 at m~2048)
-#   8. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
+#      full semiring rebuild (median-of-5 at m~2048); incremental D^T
+#      maintenance >= 5x over a full re-transpose; list_objects via the
+#      reverse index >= 10x over the per-candidate oracle scan
+#   9. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
 #
 # Usage: bash tools/check.sh            (from the repo root)
 set -o pipefail
@@ -88,10 +95,19 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/replication_gate.py || exit
 echo "== metrics lint =="
 timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/lint_metrics.py || exit 1
 
+echo "== reverse-index parity =="
+# the list-serving engine suite without the server fixture: reverse-index
+# answers byte-identical to the forward-scan oracle, token staleness, and
+# the breaker drill — the invariants the list APIs are built on
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_listing.py -q -p no:cacheprovider \
+  -k "not Surface" || exit 1
+
 echo "== closure microbench gate =="
 # incremental closure update after 1 edge must stay >= 5x faster than a
-# full rebuild (median-of-5, m~2048) — the cold-start/write-path win the
-# semiring engine exists for; regressions exit non-zero here
+# full rebuild (median-of-5, m~2048), incremental D^T maintenance >= 5x
+# over a full re-transpose, and list_objects through the reverse index
+# >= 10x over the brute-force oracle; regressions exit non-zero here
 timeout -k 10 120 python tools/closure_microbench.py --gate || exit 1
 
 echo "== tier-1 tests =="

@@ -1,4 +1,5 @@
-"""Closure-build microbench: full semiring rebuild vs incremental update.
+"""Closure-build microbench: full semiring rebuild vs incremental update,
+plus the reverse-index rungs.
 
 The point of the incremental closure path (keto_tpu.engine.semiring) is that
 a small interior edge delta costs proportional to its blast radius, not the
@@ -7,8 +8,24 @@ graph. This tool measures exactly that claim at a serving-realistic scale
 the build when the incremental update after ONE inserted edge is not at
 least 5x faster than a full rebuild (median of several trials each).
 
-Pure-host numpy path (no jax import): the gate must answer in seconds and
-not depend on which accelerator CI got.
+Two reverse-index rungs ride along (PR 17):
+
+- ``reverse``: maintaining the transposed closure D^T through a 1-edge
+  delta (``update_transpose`` over the dirty rows the bitset update
+  already computed) must be >= 5x faster than re-transposing D from
+  scratch (``transpose_closure``) — the claim that makes carrying D^T
+  through incremental builds worthwhile.
+- ``list``: answering ``list_objects`` from the reverse residency
+  (engine/listing.py) must be >= 10x faster than the brute-force oracle —
+  one check per candidate object — on an rbac1m-shaped graph
+  (users ∈ groups ∈ roles -> per-resource view grants; scale via
+  LIST_BENCH_*; the oracle side is timed over a sample of candidates and
+  extrapolated so the gate stays fast). The ratio GROWS with object
+  count, so passing at gate scale is conservative for rbac1m proper.
+
+The closure/reverse rungs are pure host numpy; the list rung builds a real
+ClosureCheckEngine pinned to JAX_PLATFORMS=cpu, so none of the gates
+depend on which accelerator CI got.
 
 Usage:
     python tools/closure_microbench.py            # print JSON numbers
@@ -25,10 +42,14 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from keto_tpu.engine.semiring import (  # noqa: E402
     build_closure_bitset,
+    transpose_closure,
     update_closure_bitset,
+    update_closure_bitset_ex,
+    update_transpose,
 )
 
 M = int(os.environ.get("CLOSURE_BENCH_M", 2048))
@@ -36,10 +57,150 @@ EDGES = int(os.environ.get("CLOSURE_BENCH_EDGES", 3 * M))
 K_MAX = int(os.environ.get("CLOSURE_BENCH_KMAX", 4))
 TRIALS = int(os.environ.get("CLOSURE_BENCH_TRIALS", 5))
 MIN_SPEEDUP = float(os.environ.get("CLOSURE_BENCH_MIN_SPEEDUP", 5.0))
+MIN_REVERSE_SPEEDUP = float(
+    os.environ.get("CLOSURE_BENCH_MIN_REVERSE_SPEEDUP", 5.0)
+)
+MIN_LIST_SPEEDUP = float(os.environ.get("LIST_BENCH_MIN_SPEEDUP", 10.0))
+LIST_USERS = int(os.environ.get("LIST_BENCH_USERS", 300))
+LIST_GROUPS = int(os.environ.get("LIST_BENCH_GROUPS", 24))
+LIST_ROLES = int(os.environ.get("LIST_BENCH_ROLES", 8))
+LIST_RESOURCES = int(os.environ.get("LIST_BENCH_RESOURCES", 3000))
+LIST_ORACLE_SAMPLE = int(os.environ.get("LIST_BENCH_ORACLE_SAMPLE", 200))
 
 
 def _m_pad(m: int) -> int:
     return ((m + 255) // 256) * 256
+
+
+def _reverse_rung(d, src, dst, rng) -> dict:
+    """Incremental D^T maintenance vs full re-transpose on a 1-edge delta.
+
+    The closure update itself runs either way; what the reverse rung
+    isolates is the choice AFTER it — re-gather only the dirty columns of
+    D^T (update_transpose) or rebuild the whole transpose."""
+    m_pad = _m_pad(M)
+    d_rev = transpose_closure(d)
+    full_s = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        transpose_closure(d)
+        full_s.append(time.perf_counter() - t0)
+    incr_s = []
+    dirty = 0
+    for i in range(TRIALS):
+        e_src = np.concatenate([src, [np.int32((29 * i + 5) % M)]])
+        e_dst = np.concatenate([dst, [np.int32((53 * i + 11) % M)]])
+        d_new, rows = update_closure_bitset_ex(
+            d, src, dst, e_src, e_dst, M, m_pad, K_MAX
+        )
+        dirty = max(dirty, int(rows.size))
+        t0 = time.perf_counter()
+        update_transpose(d_rev, d_new, rows)
+        incr_s.append(time.perf_counter() - t0)
+    full_med = float(np.median(full_s))
+    incr_med = float(np.median(incr_s))
+    return {
+        "full_transpose_median_s": round(full_med, 6),
+        "incremental_median_s": round(incr_med, 6),
+        "dirty_rows_max": dirty,
+        "speedup": round(
+            full_med / incr_med if incr_med > 0 else float("inf"), 2
+        ),
+    }
+
+
+def _list_rung(rng) -> dict:
+    """list_objects via the reverse residency vs the brute-force oracle
+    (one fallback check per candidate object) on an rbac-shaped graph.
+    The oracle side times LIST_ORACLE_SAMPLE candidates and extrapolates
+    linearly — per-candidate cost is flat across same-shaped checks."""
+    from keto_tpu.engine.closure import ClosureCheckEngine
+    from keto_tpu.engine.listing import ListEngine
+    from keto_tpu.graph.snapshot import SnapshotManager
+    from keto_tpu.relationtuple.definitions import (
+        RelationQuery,
+        RelationTuple,
+        SubjectID,
+        SubjectSet,
+    )
+    from keto_tpu.store.memory import InMemoryTupleStore
+    from keto_tpu.utils.pagination import PaginationOptions
+
+    tuples = []
+    for u in range(LIST_USERS):
+        for g in rng.choice(LIST_GROUPS, 2, replace=False):
+            tuples.append(
+                RelationTuple("rbac", f"g{g}", "member", SubjectID(f"u{u}"))
+            )
+    for g in range(LIST_GROUPS):
+        for r in rng.choice(LIST_ROLES, 2, replace=False):
+            tuples.append(
+                RelationTuple(
+                    "rbac", f"role{r}", "member",
+                    SubjectSet("rbac", f"g{g}", "member"),
+                )
+            )
+    for res in range(LIST_RESOURCES):
+        r = int(rng.integers(0, LIST_ROLES))
+        tuples.append(
+            RelationTuple(
+                "rbac", f"res{res}", "view",
+                SubjectSet("rbac", f"role{r}", "member"),
+            )
+        )
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(*tuples)
+
+    t0 = time.perf_counter()
+    eng = ClosureCheckEngine(
+        SnapshotManager(store), max_depth=5, freshness="strong",
+        rebuild_debounce_s=0.0, query_mode="host",
+    )
+    le = ListEngine(eng)
+    eng.reverse_artifacts()
+    build_s = time.perf_counter() - t0
+
+    subj = SubjectID("u7")
+    rev_s = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        page = le.list_objects(subj, "view", "rbac", max_depth=5)
+        rev_s.append(time.perf_counter() - t0)
+    assert page.source == "reverse", page.source
+
+    # candidate universe the oracle would settle one check at a time
+    cands = set()
+    token = ""
+    while True:
+        batch, token = store.get_relation_tuples(
+            RelationQuery(namespace="rbac", relation="view"),
+            PaginationOptions(token=token),
+        )
+        cands.update(t.object for t in batch)
+        if not token:
+            break
+    cands = sorted(cands)
+    fb = eng.fallback_engine()
+    sample = cands[: min(LIST_ORACLE_SAMPLE, len(cands))]
+    t0 = time.perf_counter()
+    for o in sample:
+        fb.subject_is_allowed(RelationTuple("rbac", o, "view", subj), 5)
+    per_cand = (time.perf_counter() - t0) / max(1, len(sample))
+    oracle_est = per_cand * len(cands)
+
+    rev_med = float(np.median(rev_s))
+    return {
+        "tuples": len(tuples),
+        "candidates": len(cands),
+        "oracle_sample": len(sample),
+        "matched": len(page.items),
+        "build_s": round(build_s, 4),
+        "reverse_median_s": round(rev_med, 6),
+        "oracle_estimated_s": round(oracle_est, 4),
+        "speedup": round(
+            oracle_est / rev_med if rev_med > 0 else float("inf"), 1
+        ),
+    }
 
 
 def main() -> int:
@@ -73,6 +234,8 @@ def main() -> int:
     full_med = float(np.median(full_s))
     incr_med = float(np.median(incr_s))
     speedup = full_med / incr_med if incr_med > 0 else float("inf")
+    reverse = _reverse_rung(d, src, dst, rng)
+    listing = _list_rung(rng)
     out = {
         "m": M,
         "edges": EDGES,
@@ -83,16 +246,35 @@ def main() -> int:
         "dirty_rows_median": int(np.median(dirty_counts)),
         "speedup": round(speedup, 2),
         "required_speedup": MIN_SPEEDUP if gate else None,
+        "reverse": reverse,
+        "reverse_required_speedup": MIN_REVERSE_SPEEDUP if gate else None,
+        "list": listing,
+        "list_required_speedup": MIN_LIST_SPEEDUP if gate else None,
     }
     print(json.dumps(out), flush=True)
+    failed = False
     if gate and speedup < MIN_SPEEDUP:
         print(
             f"closure incremental regression: {speedup:.2f}x < "
             f"{MIN_SPEEDUP}x required",
             file=sys.stderr,
         )
-        return 1
-    return 0
+        failed = True
+    if gate and reverse["speedup"] < MIN_REVERSE_SPEEDUP:
+        print(
+            f"reverse incremental regression: {reverse['speedup']:.2f}x < "
+            f"{MIN_REVERSE_SPEEDUP}x required",
+            file=sys.stderr,
+        )
+        failed = True
+    if gate and listing["speedup"] < MIN_LIST_SPEEDUP:
+        print(
+            f"list reverse-index regression: {listing['speedup']:.2f}x < "
+            f"{MIN_LIST_SPEEDUP}x required",
+            file=sys.stderr,
+        )
+        failed = True
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":
