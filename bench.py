@@ -1758,6 +1758,7 @@ def _smoke_defaults() -> None:
         # sharded-parity gate on the 8-way virtual mesh instead
         "BENCH_SHARDED_SERVING": "0",
         "BENCH_REPL_SECONDS": "2",
+        "BENCH_AUTOTUNE_SECONDS": "3",
         "BENCH_BUDGET_S": "240",
         "BENCH_PROBE_TIMEOUT_S": "20",
         # cluster federation ON in the gate: the smoke numbers are
@@ -2293,6 +2294,232 @@ def run_list_serving_bench() -> None:
     _heartbeat("list_serving", rps=summary["list_objects_rps"])
 
 
+def run_autotune_bench() -> None:
+    """The online autotuner (PR 18) against the REAL pipelined serving
+    path: two legs over the same warm DeviceCheckEngine. ``hand_tuned``
+    serves with the repo-default knobs (pipeline_depth=2,
+    encode_workers=2); ``autotuned`` starts DETUNED (depth 1, one
+    encoder) and lets the AutoTuner climb back through live
+    ``reconfigure()`` moves, fed by a ledger adapter that counts
+    finished checks and reads per-stage seconds off the batcher's
+    keto_pipeline_stage_seconds histogram. Both legs report the mean
+    rps of their settled second half (same estimator, same store, same
+    thread count), so the headline gains ``hand_tuned_rps`` /
+    ``autotuned_rps`` plus the controller's final knob vector
+    (``autotune_knobs``) and its move/revert counts; --smoke gates
+    ``autotuned_rps >= 0.95 * hand_tuned_rps``."""
+    import threading
+
+    from keto_tpu.engine.autotune import AutoTuner, Knob
+    from keto_tpu.engine.batcher import CheckBatcher
+    from keto_tpu.engine.device import DeviceCheckEngine
+    from keto_tpu.graph.snapshot import SnapshotManager
+    from keto_tpu.relationtuple.definitions import (
+        RelationTuple,
+        SubjectID,
+        SubjectSet,
+    )
+    from keto_tpu.store.memory import InMemoryTupleStore
+    from keto_tpu.telemetry import MetricsRegistry
+
+    leg_seconds = float(os.environ.get("BENCH_AUTOTUNE_SECONDS", 8))
+    n_threads = int(os.environ.get("BENCH_AUTOTUNE_THREADS", 6))
+    n_windows = 12
+
+    # rbac-shaped store (users -> groups -> roles -> resources): checks
+    # exercise multi-hop BFS but the build stays well under a second
+    n_users, n_groups, n_roles, n_resources = 64, 8, 4, 200
+    rng = np.random.default_rng(29)
+    tuples = []
+    for u in range(n_users):
+        for g in rng.choice(n_groups, 2, replace=False):
+            tuples.append(
+                RelationTuple("rbac", f"g{g}", "member", SubjectID(f"u{u}"))
+            )
+    for g in range(n_groups):
+        tuples.append(
+            RelationTuple(
+                "rbac", f"role{g % n_roles}", "member",
+                SubjectSet("rbac", f"g{g}", "member"),
+            )
+        )
+    for res in range(n_resources):
+        tuples.append(
+            RelationTuple(
+                "rbac", f"res{res}", "view",
+                SubjectSet("rbac", f"role{res % n_roles}", "member"),
+            )
+        )
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(*tuples)
+    engine = DeviceCheckEngine(SnapshotManager(store), max_depth=5)
+    reqs = [
+        RelationTuple(
+            "rbac", f"res{int(rng.integers(n_resources))}", "view",
+            SubjectID(f"u{int(rng.integers(n_users))}"),
+        )
+        for _ in range(512)
+    ]
+
+    class _Leg:
+        """Drive the single-check path (the pipelined one — check_batch
+        dispatches monolithically and would never touch the knobs) from
+        worker threads; per-window completion rates land in window_rps."""
+
+        def __init__(self, batcher):
+            self.batcher = batcher
+            self.done = 0
+            self.errors = 0
+            self._lock = threading.Lock()
+            self._stop = threading.Event()
+            self.window_rps: list[float] = []
+
+        def _worker(self, wid: int) -> None:
+            i = wid
+            while not self._stop.is_set():
+                try:
+                    self.batcher.check(reqs[i % len(reqs)], timeout=30)
+                except Exception:
+                    with self._lock:
+                        self.errors += 1
+                    continue
+                i += n_threads
+                with self._lock:
+                    self.done += 1
+
+        def run(self, seconds: float, on_window=None) -> None:
+            threads = [
+                threading.Thread(
+                    target=self._worker, args=(w,), daemon=True
+                )
+                for w in range(n_threads)
+            ]
+            for th in threads:
+                th.start()
+            window_s = seconds / n_windows
+            for _ in range(n_windows):
+                before = self.done
+                t0 = time.monotonic()
+                time.sleep(window_s)
+                dt = time.monotonic() - t0
+                self.window_rps.append(
+                    (self.done - before) / max(dt, 1e-9)
+                )
+                if on_window is not None:
+                    on_window()
+            self._stop.set()
+            for th in threads:
+                th.join(timeout=10)
+
+        def settled_rps(self) -> float:
+            # mean of the second half of windows: leg A's half skips any
+            # residual compile/warm cost, leg B's skips the climb itself
+            tail = self.window_rps[len(self.window_rps) // 2:]
+            return sum(tail) / max(len(tail), 1)
+
+    # -- leg A: hand-tuned defaults (plus an untimed warm drive so the
+    #    XLA bucket compiles are paid before either leg's clock starts)
+    hand = CheckBatcher(
+        engine, max_batch=128, window_s=0.0005,
+        metrics=MetricsRegistry(), pipeline_depth=2, encode_workers=2,
+    )
+    _Leg(hand).run(min(1.0, leg_seconds / 4))
+    leg_hand = _Leg(hand)
+    leg_hand.run(leg_seconds)
+    hand.close()
+
+    # -- leg B: detuned start, the controller climbs back live
+    m_auto = MetricsRegistry()
+    auto = CheckBatcher(
+        engine, max_batch=128, window_s=0.0005,
+        metrics=m_auto, pipeline_depth=1, encode_workers=1,
+    )
+    leg_auto = _Leg(auto)
+
+    class _PipelineLedger:
+        """Attribution-snapshot adapter: the contextvar TimeLedgers do
+        not propagate into the batcher's stage threads, so requests are
+        the bench loop's own completion count, wall is the monotonic
+        clock (the tuner only ever diffs), and per-stage seconds are the
+        cumulative sums of the stage histogram children."""
+
+        def snapshot(self) -> dict:
+            stages = {}
+            h = m_auto.get("keto_pipeline_stage_seconds")
+            if h is not None:
+                for labels, child in h._series():
+                    stages[labels.get("stage", "?")] = {
+                        "seconds": float(child._sum),
+                        "share_of_wall": 0.0,
+                    }
+            attributed = sum(v["seconds"] for v in stages.values())
+            wall = time.monotonic()
+            return {
+                "requests": leg_auto.done,
+                "entries": leg_auto.done,
+                "wall_s": wall,
+                "attributed_s": attributed,
+                "unattributed_s": 0.0,
+                "coverage": 1.0,
+                "stages": stages,
+            }
+
+    knobs = [
+        Knob(
+            "pipeline_depth", stage="device", lo=1, hi=4, step=1,
+            read=lambda: auto.pipeline_depth,
+            apply=lambda v: auto.reconfigure(pipeline_depth=int(v)),
+        ),
+        Knob(
+            "encode_workers", stage="encode", lo=1, hi=4, step=1,
+            read=lambda: auto.encode_workers,
+            apply=lambda v: auto.reconfigure(encode_workers=int(v)),
+        ),
+    ]
+    tuner = AutoTuner(
+        knobs,
+        attribution=_PipelineLedger(),
+        metrics=m_auto,
+        min_requests=16,
+        # CPU windows are noisy: a wider dead-band than the serving
+        # default keeps the controller from churning on jitter alone
+        revert_threshold=0.10,
+        backoff_ticks=2,
+    )
+    leg_auto.run(leg_seconds, on_window=tuner.step)
+    knob_vector = tuner.knob_values()
+    moves, reverts = tuner.moves_total, tuner.reverts_total
+    auto.close()
+
+    summary = {
+        "seconds_per_leg": round(leg_seconds, 2),
+        "threads": n_threads,
+        "checks_hand_tuned": leg_hand.done,
+        "checks_autotuned": leg_auto.done,
+        "check_errors": leg_hand.errors + leg_auto.errors,
+        "hand_tuned_rps": round(leg_hand.settled_rps(), 1),
+        "autotuned_rps": round(leg_auto.settled_rps(), 1),
+        "autotune_knobs": knob_vector,
+        "autotune_moves": moves,
+        "autotune_reverts": reverts,
+    }
+    print(
+        json.dumps({"config": "autotune", **summary}),
+        file=sys.stderr,
+        flush=True,
+    )
+    _EXTRA_HEADLINE["autotune"] = summary
+    for key in (
+        "hand_tuned_rps",
+        "autotuned_rps",
+        "autotune_knobs",
+        "autotune_moves",
+        "autotune_reverts",
+    ):
+        _EXTRA_HEADLINE[key] = summary[key]
+    _heartbeat("autotune", autotuned_rps=summary["autotuned_rps"])
+
+
 def run_sharded_serving_bench(name: str) -> None:
     """Subprocess wrapper for _sharded_serving_child: JSON rungs land on
     stderr AND in the headline's ``sharded_serving`` list, and the best
@@ -2822,6 +3049,23 @@ def main():
                 flush=True,
             )
 
+    if os.environ.get("BENCH_AUTOTUNE", "1") == "1" and not _skip_phase(
+        "autotune", 45.0
+    ):
+        try:
+            run_autotune_bench()
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            print(
+                json.dumps(
+                    {"config": "autotune", "error": repr(e)[:300]}
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+
     if os.environ.get("BENCH_SHARDED", "1") == "1" and not _skip_phase(
         "sharded", 120.0
     ):
@@ -2994,6 +3238,28 @@ def main():
                     flush=True,
                 )
                 sys.exit(3)
+        # autotune gate: the feedback controller, started DETUNED on the
+        # same engine, must recover at least 95% of hand-tuned
+        # throughput — a controller that wedges a knob at a bad value,
+        # or a reconfigure seam that stalls traffic, fails here
+        at = _EXTRA_HEADLINE.get("autotune") or {}
+        if at.get("hand_tuned_rps") and (
+            at.get("autotuned_rps", 0) < 0.95 * at["hand_tuned_rps"]
+        ):
+            print(
+                json.dumps(
+                    {
+                        "gate": "autotune_rps",
+                        "autotuned_rps": at.get("autotuned_rps"),
+                        "hand_tuned_rps": at.get("hand_tuned_rps"),
+                        "required_ratio": 0.95,
+                        "autotune_knobs": at.get("autotune_knobs"),
+                    }
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+            sys.exit(3)
 
 
 def _load_prev_headline() -> tuple[str, dict] | None:
@@ -3034,6 +3300,8 @@ _HIGHER_BETTER = (
     "device_check_rps",
     "sharded_batch_rps",
     "list_objects_rps",
+    "hand_tuned_rps",
+    "autotuned_rps",
 )
 _LOWER_BETTER = (
     "batch_p95_ms",

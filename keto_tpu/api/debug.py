@@ -126,6 +126,7 @@ class DebugContext:
         device_status_fn=None,
         cluster=None,
         instance_id: str = "",
+        autotune_fn=None,
     ):
         self.config = config
         self.flight = flight
@@ -154,6 +155,10 @@ class DebugContext:
         # timelines attribute each entry to its process
         self.cluster = cluster
         self.instance_id = instance_id or ""
+        # online-autotuner plane: a zero-arg GETTER for the registry's
+        # AutoTuner (None until autotune.enabled builds one) — a getter
+        # because /debug/autotune must observe, never construct
+        self.autotune_fn = autotune_fn
 
 
 class DebugAPI:
@@ -169,6 +174,7 @@ class DebugAPI:
         app.router.add_get("/debug/config", self.get_config)
         app.router.add_get("/debug/profile", self.get_profile)
         app.router.add_get("/debug/attribution", self.get_attribution)
+        app.router.add_get("/debug/autotune", self.get_autotune)
         app.router.add_get("/debug/pprof", self.get_pprof)
         app.router.add_get("/debug/device", self.get_device)
         app.router.add_get("/debug/cluster", self.get_cluster)
@@ -422,6 +428,33 @@ class DebugAPI:
                 )
             except Exception:
                 payload["closure_build_phases"] = None
+        return web.json_response(payload, dumps=_dumps)
+
+    async def get_autotune(self, request: web.Request) -> web.Response:
+        """The online autotuner's state: knob table with live values and
+        bounds, freeze reason, move/revert totals, and the newest-first
+        controller history (``?n=`` caps it, default 50) — every entry
+        carries the before/after attribution breakdowns, so this page
+        answers "why is the pipeline depth 4 now" without log digging.
+        The advertised ``hedge_delay_ms`` knob value here is what clients
+        feed HedgePolicy.advertise()."""
+        self._gate(request)
+        tuner = (
+            self.ctx.autotune_fn()
+            if self.ctx.autotune_fn is not None
+            else None
+        )
+        if tuner is None:
+            return web.json_response(
+                {"enabled": False, "running": False, "knobs": {}},
+                dumps=_dumps,
+            )
+        try:
+            n = int(request.rel_url.query.get("n", 50))
+        except ValueError:
+            n = 50
+        payload = tuner.snapshot()
+        payload["history"] = tuner.history(n)
         return web.json_response(payload, dumps=_dumps)
 
     async def get_device(self, request: web.Request) -> web.Response:

@@ -74,6 +74,10 @@ class HedgePolicy:
         self._latencies: list[float] = []
         self._idx = 0  # ring-buffer cursor once the window is full
         self._lock = threading.Lock()
+        # server-advertised delay (the autotuner's hedge_delay_ms knob,
+        # surfaced via /debug/autotune): weaker than an explicit delay_s
+        # override, stronger than the online estimate
+        self._advertised_s: Optional[float] = None
 
     def observe(self, latency_s: float) -> None:
         """Record one request's time-to-first-answer (hedged or not)."""
@@ -84,10 +88,26 @@ class HedgePolicy:
                 self._latencies[self._idx] = latency_s
                 self._idx = (self._idx + 1) % self.window
 
+    def advertise(self, delay_s: Optional[float]) -> None:
+        """Adopt a server-advertised hedge delay (from the /debug/autotune
+        payload's ``hedge_delay_ms`` knob value, or a response header).
+        None clears it, returning to the online estimate. The advertised
+        value is clamped to [min_delay_s, max_delay_s] — a sick server
+        must not talk the client into hedging every request."""
+        with self._lock:
+            if delay_s is None:
+                self._advertised_s = None
+            else:
+                self._advertised_s = min(
+                    self.max_delay_s, max(self.min_delay_s, float(delay_s))
+                )
+
     def current_delay_s(self) -> float:
         if self.delay_s is not None:
             return self.delay_s
         with self._lock:
+            if self._advertised_s is not None:
+                return self._advertised_s
             lat = list(self._latencies)
         if len(lat) < self.min_samples:
             return self.max_delay_s

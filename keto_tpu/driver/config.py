@@ -476,6 +476,51 @@ CONFIG_SCHEMA = {
             },
             "additionalProperties": False,
         },
+        # online autotuner (engine/autotune.py): ledger-driven feedback
+        # control of the hot serving knobs — reads the attribution
+        # breakdown each interval, moves the bottleneck stage's knob one
+        # bounded step, reverts on regression, freezes on SLO burn /
+        # breaker / HBM-pressure guards. The kill switch (enabled) is
+        # itself hot-reloadable: flipping it off in the config file stops
+        # moves at the next tick without a restart
+        "autotune": {
+            "type": "object",
+            "properties": {
+                "enabled": {"type": "boolean"},
+                # control interval between moves
+                "interval_s": {"type": "number", "exclusiveMinimum": 0},
+                # a window with fewer finished checks than this makes no
+                # move (too little signal to attribute a bottleneck)
+                "min_requests": {"type": "integer", "minimum": 1},
+                # objective (checks/s) drop past this fraction of the
+                # pre-move baseline reverts the move
+                "revert_threshold": {"type": "number", "minimum": 0},
+                # fast-window SLO burn rate at or above this freezes all
+                # moves (0 = inherit telemetry.slo.alert_burn_rate)
+                "freeze_burn_rate": {"type": "number", "minimum": 0},
+                # ticks a knob sits out after one of its moves reverted
+                "backoff_ticks": {"type": "integer", "minimum": 0},
+                # /debug/autotune history ring entries retained
+                "history": {"type": "integer", "minimum": 1},
+                # per-knob overrides, keyed by knob name (e.g.
+                # pipeline_depth, encode_workers, hbm_budget_frac):
+                # tighten bounds/step, or pin a knob with enabled: false
+                "knobs": {
+                    "type": "object",
+                    "additionalProperties": {
+                        "type": "object",
+                        "properties": {
+                            "enabled": {"type": "boolean"},
+                            "min": {"type": "number"},
+                            "max": {"type": "number"},
+                            "step": {"type": "number"},
+                        },
+                        "additionalProperties": False,
+                    },
+                },
+            },
+            "additionalProperties": False,
+        },
         # /debug surface on the read plane (api/debug.py)
         "debug": {
             "type": "object",
@@ -657,6 +702,14 @@ DEFAULTS = {
     "qos.rate": 0.0,
     "qos.burst": 100.0,
     "qos.overrides": {},
+    "autotune.enabled": False,
+    "autotune.interval_s": 5.0,
+    "autotune.min_requests": 32,
+    "autotune.revert_threshold": 0.05,
+    "autotune.freeze_burn_rate": 0.0,
+    "autotune.backoff_ticks": 3,
+    "autotune.history": 256,
+    "autotune.knobs": {},
     "debug.enabled": True,
     "debug.token": "",
     "debug.profile_max_s": 30,
@@ -712,7 +765,58 @@ IMMUTABLE_KEYS = ("dsn", "serve")
 # grafts the fresh values into the otherwise-frozen boot subtree.
 HOT_SERVE_KEYS = ("serve.read.max_freshness_wait_s",)
 
+# the registered hot-knob table: every key here may be changed on a live
+# server — by a file reload, an operator override, or the online autotuner
+# (engine/autotune.py) — and is re-applied through a component seam that
+# honors it mid-flight (driver/registry.py threads the appliers). The
+# ``engine`` block is file-mutable already; registration here is what makes
+# a key *live* (components read it per use or expose a resize seam) and
+# what gates :meth:`Config.set_hot`'s validated write path.
+HOT_ENGINE_KEYS = (
+    "engine.pipeline_depth",
+    "engine.encode_workers",
+    "engine.encoded_cache_size",
+    "engine.expand_page_size",
+    "engine.sharding.escalation_budget",
+    "engine.memory.hbm_budget_frac",
+)
+HOT_KNOB_KEYS = HOT_SERVE_KEYS + HOT_ENGINE_KEYS
+
 _HOT_MISSING = object()
+
+
+def knob_schema(key: str) -> Optional[dict]:
+    """The per-key subschema for a dotted config key, dug out of
+    CONFIG_SCHEMA's nested ``properties`` maps (None when the key has no
+    declared schema)."""
+    node: Any = CONFIG_SCHEMA
+    for part in key.split("."):
+        props = node.get("properties") if isinstance(node, dict) else None
+        if not isinstance(props, dict) or part not in props:
+            return None
+        node = props[part]
+    return node if isinstance(node, dict) else None
+
+
+def validate_knob(key: str, value: Any) -> None:
+    """Validate one hot-knob value against its schema bounds before it is
+    grafted/applied anywhere. Raises ErrMalformedInput for unregistered
+    keys or out-of-range values — a bad autotuner or operator write must
+    never install an out-of-range knob on a live server."""
+    if key not in HOT_KNOB_KEYS:
+        raise ErrMalformedInput(
+            f"{key} is not a registered hot knob "
+            f"(HOT_KNOB_KEYS: {', '.join(HOT_KNOB_KEYS)})"
+        )
+    sub = knob_schema(key)
+    if sub is None:
+        raise ErrMalformedInput(f"hot knob {key} has no schema entry")
+    try:
+        jsonschema.validate(value, sub)
+    except jsonschema.ValidationError as e:
+        raise ErrMalformedInput(
+            f"invalid value for hot knob {key}: {e.message}"
+        ) from e
 
 
 def _dig(data: dict, parts: list[str]):
@@ -828,11 +932,28 @@ class Config:
             else:
                 merged.pop(key, None)
         # hot carve-outs: graft the fresh values of HOT_SERVE_KEYS into the
-        # frozen boot subtree so these knobs really are live-reloadable
+        # frozen boot subtree so these knobs really are live-reloadable.
+        # Each value is re-validated against its own schema bounds first —
+        # the whole-file validation above covers the fresh tree, but the
+        # graft is the last write before a live component reads the knob,
+        # so it gets the same guard set_hot() gives the autotuner path
         for dotted in HOT_SERVE_KEYS:
             parts = dotted.split(".")
             new_v = _dig(fresh, parts)
             if new_v != _dig(old, parts):
+                if new_v is not _HOT_MISSING:
+                    try:
+                        validate_knob(dotted, new_v)
+                    except ErrMalformedInput as e:
+                        from ..telemetry import get_logger
+
+                        get_logger("config").warn(
+                            "hot knob reload value rejected; keeping the "
+                            "previous value",
+                            key=dotted,
+                            error=str(e),
+                        )
+                        continue
                 _graft(merged, parts, new_v)
                 applied.append(dotted)
         self._data = merged
@@ -904,6 +1025,33 @@ class Config:
 
     def set_override(self, key: str, value: Any) -> None:
         self._overrides[key] = value
+
+    def file_value(self, key: str) -> Any:
+        """The config FILE's value for ``key`` (plus DEFAULTS), ignoring
+        the override layer — how the reload watcher decides whether an
+        operator actually edited a hot knob that the autotuner has since
+        shadowed with a ``set_hot`` override."""
+        node: Any = self._data
+        for part in key.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return DEFAULTS.get(key)
+            node = node[part]
+        return node
+
+    def set_hot(self, key: str, value: Any) -> None:
+        """Validated live write to a registered hot knob (HOT_KNOB_KEYS):
+        the autotuner's (and an operator tool's) only write path. The
+        value lands in the override layer, which wins over the file tree —
+        a later file reload of other keys does not clobber a tuned knob.
+        Raises ErrMalformedInput on unregistered keys or schema-bound
+        violations, so an out-of-range value can never be installed."""
+        validate_knob(key, value)
+        self._overrides[key] = value
+
+    def clear_hot(self, key: str) -> None:
+        """Drop a hot-knob override, returning the key to its file/default
+        value (how an operator un-pins an autotuned knob)."""
+        self._overrides.pop(key, None)
 
     # -- typed accessors (reference provider.go) ------------------------------
 

@@ -376,6 +376,15 @@ class Registry:
         self._debug_context = None
         self._attribution = None
         self._profiler = None
+        # online autotuner (engine/autotune.py): built lazily by
+        # autotuner(), daemon started in start_all after any replica fork
+        self._autotuner = None
+        # the reply-stage virtual knob: the hedge delay this server
+        # currently advertises to clients (surfaced via /debug/autotune;
+        # clients adopt it with HedgePolicy.advertise). Starts at the
+        # client-side cold default (max_delay_s) so an untuned server
+        # recommends nothing aggressive
+        self._hedge_advertised_ms = 1000.0
         self._config_watcher: Optional[threading.Thread] = None
         self._config_watch_stop = threading.Event()
         # persistent XLA compilation cache: must point jax at the dir
@@ -669,6 +678,10 @@ class Registry:
                 profiler=self.profiler(),
                 build_phases_fn=self._build_phases,
                 device_status_fn=self._device_status,
+                # a GETTER, not the instance: the autotuner may be built
+                # later (autotune.enabled flipped on by a hot reload) and
+                # /debug/autotune must never construct it as a side effect
+                autotune_fn=lambda: self._autotuner,
                 cluster=self.federation(),
                 instance_id=(
                     self.cluster_instance_id()
@@ -1176,6 +1189,265 @@ class Registry:
                 )
                 self._checker = self._batcher
         return self._checker
+
+    def _hot_knob_appliers(self) -> dict:
+        """Component appliers for the registered hot engine knobs
+        (config.HOT_ENGINE_KEYS): key -> callable installing a new value
+        on the LIVE component. Shared by the autotuner's knob table and
+        the config watcher's generalized hot-reload path — both routes
+        end at exactly these seams, so a reloaded file and a controller
+        move can never disagree about what a knob write means. Rebuilt
+        per call (cheap dict of closures) so late-built components are
+        picked up; keys for components that do not exist in this serving
+        mode are simply absent."""
+        out: dict = {}
+        batcher = self._batcher
+        if batcher is not None:
+            out["engine.pipeline_depth"] = lambda v: batcher.reconfigure(
+                pipeline_depth=int(v)
+            )
+            out["engine.encode_workers"] = lambda v: batcher.reconfigure(
+                encode_workers=int(v)
+            )
+            if batcher.encoded_cache is not None:
+                out["engine.encoded_cache_size"] = (
+                    lambda v: batcher.encoded_cache.resize(int(v))
+                )
+        hbm = self._hbm_admission
+        if hbm is not None:
+            out["engine.memory.hbm_budget_frac"] = (
+                lambda v: hbm.set_budget_frac(float(v))
+            )
+        engine = self._check_engine
+        if engine is not None and hasattr(engine, "escalation_budget"):
+            out["engine.sharding.escalation_budget"] = lambda v: setattr(
+                engine, "escalation_budget", float(v)
+            )
+
+        def _apply_page_size(v):
+            for e in (self._expand_engine, self._list_engine):
+                if e is not None and hasattr(e, "default_page_size"):
+                    e.default_page_size = int(v)
+
+        out["engine.expand_page_size"] = _apply_page_size
+        return out
+
+    def _apply_hot_knob(self, key: str, value) -> None:
+        """The autotuner's write path for a config-backed knob: validated
+        config override first (so /debug/config and a restart agree with
+        the live component), then the component seam."""
+        self.config.set_hot(key, value)
+        fn = self._hot_knob_appliers().get(key)
+        if fn is not None:
+            fn(value)
+
+    def autotuner(self):
+        """The online autotuner (engine/autotune.py): ledger-driven
+        feedback control of the serving knobs. Constructing it builds the
+        checker first so the batcher/breaker seams exist; the control
+        thread itself is started only from start_all (fork hygiene) or by
+        the config watcher when autotune.enabled flips on."""
+        if self._autotuner is None:
+            from ..engine.autotune import AutoTuner, Knob
+
+            cfg = self.config
+            self.checker()
+            overrides = cfg.get("autotune.knobs", default={}) or {}
+
+            def build(name: str, **kw) -> Knob:
+                o = (
+                    overrides.get(name)
+                    if isinstance(overrides, dict)
+                    else None
+                )
+                if isinstance(o, dict):
+                    # operator pin/re-bound per knob:
+                    # autotune.knobs.<name>.{enabled,min,max,step}
+                    if "min" in o:
+                        kw["lo"] = o["min"]
+                    if "max" in o:
+                        kw["hi"] = o["max"]
+                    if "step" in o:
+                        kw["step"] = o["step"]
+                    if "enabled" in o:
+                        kw["enabled"] = bool(o["enabled"])
+                return Knob(name, **kw)
+
+            knobs = []
+            batcher = self._batcher
+            if batcher is not None:
+                knobs.append(
+                    build(
+                        "encode_workers",
+                        stage="queue",
+                        lo=1,
+                        hi=8,
+                        step=1,
+                        read=lambda: batcher.encode_workers,
+                        apply=lambda v: self._apply_hot_knob(
+                            "engine.encode_workers", int(v)
+                        ),
+                        key="engine.encode_workers",
+                    )
+                )
+                knobs.append(
+                    build(
+                        "pipeline_depth",
+                        stage="launch",
+                        lo=1,
+                        hi=8,
+                        step=1,
+                        read=lambda: batcher.pipeline_depth,
+                        apply=lambda v: self._apply_hot_knob(
+                            "engine.pipeline_depth", int(v)
+                        ),
+                        key="engine.pipeline_depth",
+                    )
+                )
+                if batcher.encoded_cache is not None:
+                    knobs.append(
+                        build(
+                            "encoded_cache_size",
+                            stage="encode",
+                            lo=1024,
+                            hi=1 << 20,
+                            step=65536,
+                            read=lambda: batcher.encoded_cache.capacity,
+                            apply=lambda v: self._apply_hot_knob(
+                                "engine.encoded_cache_size", int(v)
+                            ),
+                            key="engine.encoded_cache_size",
+                        )
+                    )
+            if self._hbm_admission is not None:
+                hbm = self._hbm_admission
+                knobs.append(
+                    build(
+                        "hbm_budget_frac",
+                        stage="kernel",
+                        lo=0.1,
+                        hi=0.95,
+                        step=0.05,
+                        integer=False,
+                        read=lambda: hbm.budget_frac,
+                        apply=lambda v: self._apply_hot_knob(
+                            "engine.memory.hbm_budget_frac",
+                            round(float(v), 4),
+                        ),
+                        key="engine.memory.hbm_budget_frac",
+                    )
+                )
+            engine = self._check_engine
+            if engine is not None and hasattr(engine, "escalation_budget"):
+                knobs.append(
+                    build(
+                        "escalation_budget",
+                        stage="kernel",
+                        lo=0.01,
+                        hi=0.5,
+                        step=0.02,
+                        integer=False,
+                        read=lambda: engine.escalation_budget,
+                        apply=lambda v: self._apply_hot_knob(
+                            "engine.sharding.escalation_budget",
+                            round(float(v), 4),
+                        ),
+                        key="engine.sharding.escalation_budget",
+                    )
+                )
+            expand = self._expand_engine
+            if expand is not None and getattr(
+                expand, "default_page_size", 0
+            ):
+                # paging disabled (size 0) stays disabled: turning it ON
+                # would change response shapes, which a tuner must not do
+                knobs.append(
+                    build(
+                        "expand_page_size",
+                        stage="serialize",
+                        lo=256,
+                        hi=8192,
+                        step=256,
+                        higher_helps=False,
+                        read=lambda: expand.default_page_size,
+                        apply=lambda v: self._apply_hot_knob(
+                            "engine.expand_page_size", int(v)
+                        ),
+                        key="engine.expand_page_size",
+                    )
+                )
+
+            def _advertise_hedge(v):
+                self._hedge_advertised_ms = float(v)
+
+            knobs.append(
+                build(
+                    "hedge_delay_ms",
+                    stage="reply",
+                    lo=1,
+                    hi=1000,
+                    step=10,
+                    higher_helps=False,
+                    read=lambda: self._hedge_advertised_ms,
+                    apply=_advertise_hedge,
+                )
+            )
+
+            def _breaker_guard():
+                b = self._engine_breaker
+                if b is None:
+                    return None
+                try:
+                    if b.breaker_snapshot()["open"]:
+                        return "breaker_open"
+                except Exception:
+                    pass
+                return None
+
+            def _hbm_guard():
+                h = self._hbm_admission
+                if h is None:
+                    return None
+                try:
+                    snap = h.snapshot()
+                    if (
+                        snap.get("headroom_bytes", 1) <= 0
+                        and snap.get("inflight_bytes", 0) > 0
+                    ):
+                        return "hbm_pressure"
+                except Exception:
+                    pass
+                return None
+
+            self._autotuner = AutoTuner(
+                knobs,
+                attribution=self.attribution(),
+                slo=self.slo(),
+                metrics=self.metrics(),
+                flight=self.flight(),
+                logger=self.logger(),
+                interval_s=float(
+                    cfg.get("autotune.interval_s", default=5.0)
+                ),
+                min_requests=int(
+                    cfg.get("autotune.min_requests", default=32)
+                ),
+                revert_threshold=float(
+                    cfg.get("autotune.revert_threshold", default=0.05)
+                ),
+                freeze_burn_rate=float(
+                    cfg.get("autotune.freeze_burn_rate", default=0.0)
+                ),
+                backoff_ticks=int(
+                    cfg.get("autotune.backoff_ticks", default=3)
+                ),
+                history=int(cfg.get("autotune.history", default=256)),
+                enabled_fn=lambda: bool(
+                    cfg.get("autotune.enabled", default=False)
+                ),
+                guards=(_breaker_guard, _hbm_guard),
+            )
+        return self._autotuner
 
     def encoded_front(self):
         """The id-native check tier (api/encoded.py): epoch gate + id
@@ -2172,6 +2444,12 @@ class Registry:
             # continuous sampling profiler: started only here — after any
             # replica fork — so its thread never violates fork hygiene
             self.profiler().start()
+        if bool(self.config.get("autotune.enabled", default=False)):
+            # the feedback controller thread: same after-the-fork rule as
+            # the profiler. Flipping autotune.enabled off via hot reload
+            # freezes it in place (every tick short-circuits); flipping it
+            # ON later is handled by the config watcher
+            self.autotuner().start()
         self.health.set_serving(True)  # readiness flips only after bring-up
         log.info(
             "serving",
@@ -2285,12 +2563,19 @@ class Registry:
             return
         path = self.config.config_file
         log = self.logger()
+        from .config import HOT_ENGINE_KEYS
 
         def watch():
             try:
                 last = os.stat(path).st_mtime
             except OSError:
                 last = 0.0
+            # file values of the hot engine knobs as of boot: a reload
+            # applies a knob only when the OPERATOR edited it, so a file
+            # touch never clobbers values the autotuner has tuned since
+            knob_file = {
+                k: self.config.file_value(k) for k in HOT_ENGINE_KEYS
+            }
             while not self._config_watch_stop.wait(poll_interval_s):
                 try:
                     mtime = os.stat(path).st_mtime
@@ -2318,6 +2603,46 @@ class Registry:
                                 self.config.get("log.format", default="text")
                             ),
                         )
+                    if "engine" in applied:
+                        # generalized hot-reload path: an edited engine
+                        # hot knob lands on the live component through
+                        # the same appliers the autotuner uses. The
+                        # operator's file edit outranks a tuned value, so
+                        # any shadowing set_hot override is dropped first
+                        appliers = self._hot_knob_appliers()
+                        for key in HOT_ENGINE_KEYS:
+                            new_v = self.config.file_value(key)
+                            if new_v == knob_file.get(key):
+                                continue
+                            knob_file[key] = new_v
+                            self.config.clear_hot(key)
+                            fn = appliers.get(key)
+                            if fn is None:
+                                continue
+                            try:
+                                fn(new_v)
+                                log.info(
+                                    "hot knob reloaded",
+                                    key=key,
+                                    value=new_v,
+                                )
+                            except Exception as e:
+                                log.warn(
+                                    "hot knob reload apply failed",
+                                    key=key,
+                                    error=str(e),
+                                )
+                    if "autotune" in applied and bool(
+                        self.config.get("autotune.enabled", default=False)
+                    ):
+                        # flipped on after boot: build + start now (off ->
+                        # the daemon's own tick sees enabled_fn false)
+                        try:
+                            self.autotuner().start()
+                        except Exception as e:
+                            log.warn(
+                                "autotuner start failed", error=str(e)
+                            )
                     if "tracing" in applied and self._tracer is not None:
                         self._tracer.reconfigure(
                             str(
@@ -2385,6 +2710,11 @@ class Registry:
         if self._wire_ring is not None:
             self._wire_ring.close()
             self._wire_ring = None
+        if self._autotuner is not None:
+            # before the batcher close: a mid-shutdown knob move must not
+            # race reconfigure() against close()
+            self._autotuner.stop()
+            self._autotuner = None
         if self._config_watcher is not None:
             self._config_watch_stop.set()
             self._config_watcher.join(timeout=5)

@@ -238,6 +238,12 @@ class CheckBatcher:
         # fails the stragglers after the join budget
         self._pipe_batches: dict[int, _PBatch] = {}
         self._closed = False
+        # reconfigure(): quiesce asks the stage threads to drain in-flight
+        # batches and exit WITHOUT failing the queue — queued entries stay
+        # put and the rebuilt pipeline picks them up
+        self._quiesce = False
+        self._reconfig_lock = threading.Lock()
+        self._metrics = metrics
         # close() lets the dispatcher drain for this long before failing
         # the leftovers typed; only a wedged engine ever exhausts it
         self.close_join_s = 5.0
@@ -252,22 +258,31 @@ class CheckBatcher:
                 maxsize=max(1, pipeline_depth)
             )
             self._encoders_live = self.encode_workers
-            if metrics is not None:
-                metrics.gauge(
-                    "keto_pipeline_launch_queue_depth",
-                    "encoded batches waiting for kernel dispatch",
-                    fn=self._launch_q.qsize,
-                )
-                metrics.gauge(
-                    "keto_pipeline_decode_queue_depth",
-                    "launched batches in flight awaiting decode",
-                    fn=self._decode_q.qsize,
-                )
+            self._register_pipeline_metrics()
             self._threads = self._spawn_pipeline()
             self._thread = self._threads[0]  # close()/tests compatibility
         else:
             self._thread = self._spawn_dispatcher()
             self._threads = [self._thread]
+
+    def _register_pipeline_metrics(self) -> None:
+        """Queue-depth gauges + stage histogram for the pipelined shape.
+        The gauges sample through lambdas (not bound queue methods) so a
+        reconfigure() that swaps the queue objects keeps them live;
+        re-registration after a serial->pipelined transition dedups to the
+        same metric and rebinds its sampler."""
+        metrics = self._metrics
+        if metrics is None:
+            return
+        metrics.gauge(
+            "keto_pipeline_launch_queue_depth",
+            "encoded batches waiting for kernel dispatch",
+        ).set_fn(lambda: self._launch_q.qsize())
+        metrics.gauge(
+            "keto_pipeline_decode_queue_depth",
+            "launched batches in flight awaiting decode",
+        ).set_fn(lambda: self._decode_q.qsize())
+        self._m_stage = pipeline_stage_histogram(metrics)
 
     def _spawn_dispatcher(self) -> threading.Thread:
         t = threading.Thread(
@@ -826,6 +841,107 @@ class CheckBatcher:
             )
         return out
 
+    def reconfigure(
+        self,
+        pipeline_depth: Optional[int] = None,
+        encode_workers: Optional[int] = None,
+    ) -> bool:
+        """Resize the dispatch pipeline on a live batcher — the autotuner's
+        seam for ``engine.pipeline_depth`` / ``engine.encode_workers``.
+
+        Correctness contract: in-flight batches drain FIRST. The quiesce
+        flag makes every stage loop exit through :meth:`_await_work`
+        without draining the admission queue; the encode-worker sentinel
+        cascade then flushes everything already past encode through
+        launch/decode in FIFO order, so no caller future is dropped or
+        failed by a clean resize. Queued requests simply wait out the
+        swap (callers block on their futures as usual) and the rebuilt
+        stage threads pick them up. Serial <-> pipelined transitions are
+        handled: the new shape is re-derived from the engine's
+        capabilities exactly as in ``__init__``.
+
+        Only a wedged engine can exhaust the join budget; the batches a
+        wedged stage still holds are then failed typed (retryable), the
+        same contract a stage death gives.
+
+        Returns True when the pipeline was rebuilt, False for a no-op.
+        Fault site ``batcher.reconfigure_stall`` stalls the drain window
+        (tests/test_faults.py drills traffic through it)."""
+        with self._reconfig_lock:
+            new_depth = (
+                self.pipeline_depth
+                if pipeline_depth is None
+                else max(0, int(pipeline_depth))
+            )
+            new_workers = (
+                self.encode_workers
+                if encode_workers is None
+                else max(1, int(encode_workers))
+            )
+            if (
+                new_depth == self.pipeline_depth
+                and new_workers == self.encode_workers
+            ):
+                return False
+            with self._cv:
+                if self._closed:
+                    raise BatcherClosed()
+                self._quiesce = True
+                self._cv.notify_all()
+            # the drain window: in-flight batches flush through the
+            # sentinel cascade while new arrivals pool in the queue
+            FAULTS.maybe_sleep("batcher.reconfigure_stall")
+            deadline = time.monotonic() + self.close_join_s
+            for t in self._threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            stragglers: list[tuple] = []
+            with self._cv:
+                # a wedged stage (engine hang) keeps its batch past the
+                # join budget: fail exactly those typed, like a stage
+                # death would — queued entries are NOT touched
+                stragglers.extend(self._inflight)
+                self._inflight = []
+                for b in self._pipe_batches.values():
+                    stragglers.extend(b.items)
+                self._pipe_batches = {}
+                self._quiesce = False
+                self.pipeline_depth = new_depth
+                self.encode_workers = new_workers
+                sup = getattr(self.engine, "pipeline_supported", None)
+                capable = (
+                    sup()
+                    if callable(sup)
+                    else callable(getattr(self.engine, "encode_batch", None))
+                )
+                self.pipelined = new_depth >= 1 and capable
+            for item in stragglers:
+                f = item[2]
+                if not f.done():
+                    f.set_exception(DispatcherCrashed())
+            if self.pipelined:
+                self._launch_q = _queue_mod.Queue(
+                    maxsize=max(2, self.encode_workers)
+                )
+                self._decode_q = _queue_mod.Queue(
+                    maxsize=max(1, new_depth)
+                )
+                self._encoders_live = self.encode_workers
+                self._register_pipeline_metrics()
+                self._threads = self._spawn_pipeline()
+                self._thread = self._threads[0]
+            else:
+                self._thread = self._spawn_dispatcher()
+                self._threads = [self._thread]
+            if self._logger is not None:
+                self._logger.info(
+                    "check batcher reconfigured",
+                    pipeline_depth=new_depth,
+                    encode_workers=new_workers,
+                    pipelined=self.pipelined,
+                    failed_stragglers=len(stragglers),
+                )
+            return True
+
     # -- shared plumbing -----------------------------------------------------
 
     def _drain(self) -> list[tuple]:
@@ -835,11 +951,15 @@ class CheckBatcher:
 
     def _await_work(self) -> Optional[list[tuple]]:
         """Block for queued requests; returns None on clean shutdown with
-        an empty queue, else the drained batch (after the accumulation
-        window when only one request is waiting)."""
+        an empty queue — or immediately on a reconfigure quiesce, BEFORE
+        draining, so queued entries stay intact for the rebuilt pipeline —
+        else the drained batch (after the accumulation window when only
+        one request is waiting)."""
         with self._cv:
-            while not self._queue and not self._closed:
+            while not self._queue and not self._closed and not self._quiesce:
                 self._cv.wait()
+            if self._quiesce and not self._closed:
+                return None
             if self._closed and not self._queue:
                 return None
             first_only = len(self._queue) == 1

@@ -113,6 +113,16 @@ class CheckResultCache:
             while len(entries) > self.capacity:
                 entries.popitem(last=False)
 
+    def resize(self, capacity: int) -> None:
+        """Hot-apply a new capacity (the autotuner's seam for
+        engine.encoded_cache_size / engine.cache_size): shrinking trims
+        LRU entries immediately instead of waiting for the next put."""
+        capacity = max(0, int(capacity))
+        with self._lock:
+            self.capacity = capacity
+            while len(self._entries) > capacity:
+                self._entries.popitem(last=False)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

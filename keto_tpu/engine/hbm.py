@@ -341,6 +341,17 @@ class HbmAdmission:
 
     # -- introspection ---------------------------------------------------------
 
+    def set_budget_frac(self, frac: float) -> None:
+        """Hot-apply a new budget fraction (the autotuner's seam for
+        engine.memory.hbm_budget_frac): same clamp as the constructor,
+        and the calibration timestamp resets so the next admission call
+        recomputes the byte budget immediately instead of waiting out the
+        calibration interval."""
+        with self._lock:
+            self.budget_frac = min(1.0, max(0.05, float(frac)))
+            self._calibrated_at = float("-inf")
+            self._headroom_wake.notify_all()
+
     def snapshot(self) -> dict:
         with self._lock:
             budget = self._budget_bytes

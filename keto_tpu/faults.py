@@ -110,6 +110,11 @@ site                      seam that honors it when armed
                           device dispatch (engine/batcher.py)
 ``batcher.decode_slow``   the pipeline decode thread stalls before decoding
                           a launched batch (engine/batcher.py)
+``batcher.reconfigure_stall``  a live ``reconfigure()`` stalls in its drain
+                          window after quiescing the stages — in-flight
+                          batches must still flush and queued requests
+                          must survive into the rebuilt pipeline
+                          (engine/batcher.py)
 ``device.slow``           the device engine stalls inside the dispatch
                           itself (engine/device.py)
 ``delta.slow``            the parent stalls before broadcasting a delta

@@ -1,0 +1,640 @@
+"""Online autotuner (engine/autotune.py): the ledger-driven feedback
+controller over the serving knobs.
+
+Deterministic drills against a scripted attribution ledger: bounded hill
+climbing converges to the helpful bound within a handful of control
+ticks, a throughput regression reverts the move and backs the knob off,
+SLO burn / guard signals freeze all moves (and roll back a pending one),
+bounds are never exceeded no matter how adversarial the traffic, and
+every decision is visible as a flight record with before/after stage
+breakdowns. Plus the hot-knob validation seam (Config.set_hot /
+validate_knob — satellite 1), the CheckBatcher.reconfigure quiesce seam,
+and one end-to-end pass proving a knob move shows up in all three
+surfaces at once: flight kind=autotune, /debug/autotune, and
+keto_autotune_moves_total.
+"""
+
+import threading
+import time
+
+import httpx
+import pytest
+
+from keto_tpu.driver.config import (
+    Config,
+    HOT_ENGINE_KEYS,
+    HOT_KNOB_KEYS,
+    validate_knob,
+)
+from keto_tpu.engine.autotune import AutoTuner, Knob
+from keto_tpu.utils.errors import ErrMalformedInput
+from keto_tpu.telemetry import MetricsRegistry
+from keto_tpu.telemetry.flight import FlightRecorder
+
+
+class _ScriptedLedger:
+    """Cumulative attribution snapshots under test control: each
+    ``advance`` is one control window's worth of traffic."""
+
+    def __init__(self):
+        self._requests = 0
+        self._wall = 0.0
+        self._stages: dict = {}
+
+    def advance(self, requests: int, wall_s: float, stages: dict) -> None:
+        self._requests += int(requests)
+        self._wall += float(wall_s)
+        for s, v in stages.items():
+            self._stages[s] = self._stages.get(s, 0.0) + float(v)
+
+    def snapshot(self) -> dict:
+        return {
+            "requests": self._requests,
+            "entries": self._requests,
+            "wall_s": round(self._wall, 6),
+            "attributed_s": round(sum(self._stages.values()), 6),
+            "unattributed_s": 0.0,
+            "coverage": 1.0,
+            "stages": {
+                s: {"seconds": round(v, 6), "share_of_wall": 0.0}
+                for s, v in self._stages.items()
+            },
+        }
+
+
+class _Holder:
+    """A knob target recording every applied value."""
+
+    def __init__(self, value):
+        self.value = value
+        self.applied: list = []
+
+    def read(self):
+        return self.value
+
+    def apply(self, v):
+        self.applied.append(v)
+        self.value = v
+
+
+class _FakeSLO:
+    def __init__(self):
+        self.burn = 0.0
+        self.fast_window_s = 300.0
+        self.alert_burn_rate = 14.4
+
+    def burn_rate(self, window_s):
+        return self.burn
+
+
+def _knob(holder, name="encode_workers", stage="queue", lo=1, hi=8,
+          step=1, **kw):
+    return Knob(
+        name, stage=stage, lo=lo, hi=hi, step=step,
+        read=holder.read, apply=holder.apply, **kw,
+    )
+
+
+def _tuner(knobs, ledger, **kw):
+    kw.setdefault("min_requests", 10)
+    kw.setdefault("backoff_ticks", 3)
+    return AutoTuner(knobs, attribution=ledger, **kw)
+
+
+class TestHillClimb:
+    def test_converges_to_bound_within_n_steps(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(2)
+        t = _tuner([_knob(holder)], ledger)
+        # queue-bound traffic whose throughput rewards every raise: the
+        # climber must reach the upper bound and then hold steady
+        for _ in range(20):
+            ledger.advance(100 + 50 * holder.value, 1.0, {"queue": 0.6})
+            t.step()
+        assert holder.value == 8
+        assert all(1 <= v <= 8 for v in holder.applied)
+        assert t.moves_total == 6  # 2 -> 8 in unit steps, then steady
+        assert t.reverts_total == 0
+        assert t.step()["action"] in ("steady", "idle")
+
+    def test_moves_the_bottleneck_stages_knob_only(self):
+        ledger = _ScriptedLedger()
+        q, k = _Holder(2), _Holder(0.5)
+        t = _tuner(
+            [
+                _knob(q, name="encode_workers", stage="queue"),
+                _knob(
+                    k, name="hbm_budget_frac", stage="kernel",
+                    lo=0.1, hi=0.95, step=0.05, integer=False,
+                ),
+            ],
+            ledger,
+        )
+        t.step()  # warmup
+        ledger.advance(100, 1.0, {"kernel": 0.7, "queue": 0.1})
+        ev = t.step()
+        assert ev["action"] == "move" and ev["knob"] == "hbm_budget_frac"
+        assert k.applied and not q.applied
+
+    def test_lower_is_better_direction(self):
+        ledger = _ScriptedLedger()
+        page = _Holder(2048)
+        t = _tuner(
+            [
+                _knob(
+                    page, name="expand_page_size", stage="serialize",
+                    lo=256, hi=8192, step=256, higher_helps=False,
+                )
+            ],
+            ledger,
+        )
+        t.step()
+        ledger.advance(100, 1.0, {"serialize": 0.8})
+        ev = t.step()
+        assert ev["action"] == "move"
+        assert page.value == 1792 and ev["direction"] == -1
+
+    def test_disabled_knob_and_unowned_stage_never_move(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(2)
+        t = _tuner([_knob(holder, enabled=False)], ledger)
+        t.step()
+        ledger.advance(100, 1.0, {"queue": 0.9, "unattributed": 2.0})
+        assert t.step()["action"] == "steady"
+        assert holder.applied == []
+
+
+class TestRevert:
+    def test_revert_on_regression_with_backoff(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(2)
+        flight = FlightRecorder(capacity=64)
+        t = _tuner(
+            [_knob(holder)], ledger, flight=flight, revert_threshold=0.05
+        )
+        t.step()  # warmup
+        ledger.advance(100, 1.0, {"queue": 0.6})
+        assert t.step()["action"] == "move"  # 2 -> 3, baseline 100/s
+        ledger.advance(50, 1.0, {"queue": 0.6})  # throughput halves
+        ev = t.step()
+        assert ev["action"] == "revert" and ev["reason"] == "regression"
+        assert holder.value == 2
+        assert t.reverts_total == 1
+        # the reverted (knob, direction) sits out backoff_ticks ticks
+        for _ in range(3):
+            ledger.advance(100, 1.0, {"queue": 0.6})
+            assert t.step()["action"] == "steady"
+            assert holder.value == 2
+        ledger.advance(100, 1.0, {"queue": 0.6})
+        assert t.step()["action"] == "move"  # backoff expired: retries
+        # the revert flight record carries BOTH breakdowns
+        revert = [
+            r for r in flight.records() if r.get("action") == "revert"
+        ][0]
+        assert revert["kind"] == "autotune"
+        assert "queue" in revert["before"] and "queue" in revert["after"]
+
+    def test_commit_on_improvement_keeps_value(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(2)
+        t = _tuner([_knob(holder)], ledger)
+        t.step()
+        ledger.advance(100, 1.0, {"queue": 0.6})
+        t.step()  # move 2 -> 3
+        ledger.advance(150, 1.0, {"queue": 0.6})  # improved
+        ev = t.step()  # commit, then the next move in the same tick
+        assert holder.value == 4
+        assert t.reverts_total == 0
+        assert ev["action"] == "move"
+
+    def test_bounds_never_exceeded_under_adversarial_traffic(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(4)
+        t = _tuner([_knob(holder)], ledger, revert_threshold=0.05)
+        # throughput that punishes every second window: moves and reverts
+        # interleave, and no applied value may ever leave [lo, hi]
+        for i in range(40):
+            rate = 200 if i % 2 else 40
+            ledger.advance(rate, 1.0, {"queue": 0.6})
+            t.step()
+        assert all(1 <= v <= 8 for v in holder.applied)
+        assert 1 <= holder.value <= 8
+        assert t.reverts_total > 0
+
+    def test_apply_failure_disqualifies_the_knob(self):
+        ledger = _ScriptedLedger()
+
+        class _Refusing(_Holder):
+            def apply(self, v):
+                raise RuntimeError("component closed")
+
+        bad, good = _Refusing(2), _Holder(0.5)
+        t = _tuner(
+            [
+                _knob(bad, name="encode_workers", stage="queue"),
+                _knob(
+                    good, name="hbm_budget_frac", stage="queue",
+                    lo=0.1, hi=0.95, step=0.05, integer=False,
+                ),
+            ],
+            ledger,
+        )
+        t.step()
+        ledger.advance(100, 1.0, {"queue": 0.6})
+        ev = t.step()
+        # the refusing knob is skipped; its stage-mate gets the move
+        assert ev["action"] == "move" and ev["knob"] == "hbm_budget_frac"
+        assert bad.value == 2 and good.applied
+
+
+class TestFreeze:
+    def test_slo_burn_freezes_moves(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(2)
+        slo = _FakeSLO()
+        t = _tuner([_knob(holder)], ledger, slo=slo)
+        t.step()
+        slo.burn = 20.0  # past alert_burn_rate (freeze inherits it)
+        ledger.advance(100, 1.0, {"queue": 0.6})
+        ev = t.step()
+        assert ev["action"] == "frozen" and ev["reason"] == "slo_burn"
+        assert holder.applied == [] and t.moves_total == 0
+        slo.burn = 0.0
+        ledger.advance(100, 1.0, {"queue": 0.6})
+        assert t.step()["action"] == "move"  # thawed
+
+    def test_freeze_reverts_the_pending_move(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(2)
+        slo = _FakeSLO()
+        t = _tuner([_knob(holder)], ledger, slo=slo)
+        t.step()
+        ledger.advance(100, 1.0, {"queue": 0.6})
+        t.step()  # move 2 -> 3, now pending
+        slo.burn = 20.0
+        ledger.advance(200, 1.0, {"queue": 0.6})  # even improving traffic
+        ev = t.step()
+        assert ev["action"] == "revert" and ev["reason"] == "slo_burn"
+        assert holder.value == 2
+
+    def test_guard_freezes_with_its_reason(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(2)
+        open_ = {"v": False}
+        t = _tuner(
+            [_knob(holder)], ledger,
+            guards=(lambda: "breaker_open" if open_["v"] else None,),
+        )
+        t.step()
+        open_["v"] = True
+        ledger.advance(100, 1.0, {"queue": 0.6})
+        ev = t.step()
+        assert ev["action"] == "frozen" and ev["reason"] == "breaker_open"
+        assert t.snapshot()["frozen"] == "breaker_open"
+
+    def test_kill_switch_short_circuits_and_resets(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(2)
+        enabled = {"v": True}
+        t = _tuner(
+            [_knob(holder)], ledger, enabled_fn=lambda: enabled["v"]
+        )
+        t.step()
+        ledger.advance(100, 1.0, {"queue": 0.6})
+        t.step()  # move pending
+        enabled["v"] = False
+        ledger.advance(10, 1.0, {"queue": 0.6})
+        assert t.step()["action"] == "disabled"
+        assert t.snapshot()["enabled"] is False
+        # re-enabling starts from a fresh window: first tick is warmup,
+        # the stale pending move is never judged against stale baselines
+        enabled["v"] = True
+        assert t.step()["action"] == "warmup"
+
+    def test_idle_window_makes_no_move(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(2)
+        t = _tuner([_knob(holder)], ledger, min_requests=32)
+        t.step()
+        ledger.advance(5, 1.0, {"queue": 0.6})
+        assert t.step()["action"] == "idle"
+        assert holder.applied == []
+
+
+class TestVisibilityPlumbing:
+    def test_metrics_and_history_and_snapshot(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(2)
+        m = MetricsRegistry()
+        flight = FlightRecorder(capacity=64)
+        t = _tuner([_knob(holder)], ledger, metrics=m, flight=flight)
+        t.step()
+        ledger.advance(100, 1.0, {"queue": 0.6})
+        t.step()  # move
+        ledger.advance(40, 1.0, {"queue": 0.6})
+        t.step()  # revert
+        text = m.expose()
+        assert (
+            'keto_autotune_moves_total{direction="up",'
+            'knob="encode_workers"} 1' in text
+            or 'keto_autotune_moves_total{knob="encode_workers",'
+            'direction="up"} 1' in text
+        )
+        assert "keto_autotune_reverts_total 1" in text
+        # the per-knob gauge samples the LIVE value (post-revert)
+        assert (
+            'keto_autotune_knob_value{knob="encode_workers"} 2' in text
+        )
+        hist = t.history()
+        assert hist[0]["action"] == "revert"  # newest first
+        assert hist[1]["action"] == "move"
+        snap = t.snapshot()
+        assert snap["moves_total"] == 1 and snap["reverts_total"] == 1
+        assert snap["knobs"]["encode_workers"]["value"] == 2
+        kinds = {r.get("kind") for r in flight.records()}
+        assert kinds == {"autotune"}
+
+    def test_daemon_start_stop(self):
+        ledger = _ScriptedLedger()
+        holder = _Holder(2)
+        t = _tuner([_knob(holder)], ledger, interval_s=0.01)
+        t.start()
+        t.start()  # idempotent
+        deadline = time.time() + 5
+        while t.ticks < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        t.stop()
+        assert t.ticks >= 3
+        assert t.snapshot()["running"] is False
+
+
+class TestKnobRecord:
+    def test_clamp_and_validation(self):
+        h = _Holder(2)
+        k = _knob(h, lo=1, hi=8, step=1)
+        assert k.clamp(0) == 1 and k.clamp(99) == 8 and k.clamp(3.6) == 4
+        with pytest.raises(ValueError):
+            _knob(h, lo=8, hi=1)
+        with pytest.raises(ValueError):
+            _knob(h, step=0)
+
+    def test_per_knob_config_overrides_via_registry_builder(self):
+        from keto_tpu.driver import Registry
+
+        cfg = Config(
+            values={
+                "namespaces": [{"id": 1, "name": "n"}],
+                "log": {"level": "error"},
+                "autotune": {
+                    "enabled": True,
+                    "knobs": {
+                        "pipeline_depth": {"enabled": False},
+                        "encode_workers": {"max": 4, "step": 2},
+                    },
+                },
+            },
+            env={},
+        )
+        reg = Registry(cfg)
+        try:
+            t = reg.autotuner()
+            knobs = {k.name: k for k in t.knobs}
+            assert knobs["pipeline_depth"].enabled is False
+            assert knobs["encode_workers"].hi == 4
+            assert knobs["encode_workers"].step == 2
+            # the reply-stage virtual knob is always present
+            assert "hedge_delay_ms" in knobs
+        finally:
+            reg._batcher.close()
+
+
+class TestHotKnobValidation:
+    """Satellite 1: every hot-reload/graft value passes its schema bounds
+    before a live component can see it."""
+
+    def test_set_hot_validates_bounds(self):
+        cfg = Config(values={"dsn": "memory"}, env={})
+        cfg.set_hot("engine.pipeline_depth", 4)
+        assert cfg.get("engine.pipeline_depth") == 4
+        with pytest.raises(ErrMalformedInput):
+            cfg.set_hot("engine.pipeline_depth", -1)
+        with pytest.raises(ErrMalformedInput):
+            cfg.set_hot("engine.encode_workers", 0)
+        with pytest.raises(ErrMalformedInput):
+            cfg.set_hot("engine.memory.hbm_budget_frac", 1.5)
+        with pytest.raises(ErrMalformedInput):
+            cfg.set_hot("serve.read.max_freshness_wait_s", -2)
+        cfg.clear_hot("engine.pipeline_depth")
+        assert cfg.get("engine.pipeline_depth") == 2  # back to default
+
+    def test_set_hot_rejects_unregistered_keys(self):
+        cfg = Config(values={"dsn": "memory"}, env={})
+        with pytest.raises(ErrMalformedInput, match="not a registered"):
+            cfg.set_hot("engine.batch_window_us", 100)
+        with pytest.raises(ErrMalformedInput):
+            cfg.set_hot("dsn", "sqlite://elsewhere")
+
+    def test_every_registered_knob_has_a_schema_entry(self):
+        for key in HOT_KNOB_KEYS:
+            validate_knob(key, 1 if key in HOT_ENGINE_KEYS else 1.0)
+
+    def test_reload_graft_rejects_out_of_bounds_hot_value(self, tmp_path):
+        import json
+
+        path = tmp_path / "keto.json"
+        doc = {
+            "dsn": "memory",
+            "namespaces": [{"id": 1, "name": "n"}],
+            "serve": {"read": {"max_freshness_wait_s": 5.0}},
+        }
+        path.write_text(json.dumps(doc))
+        cfg = Config(config_file=str(path), env={})
+        assert cfg.get("serve.read.max_freshness_wait_s") == 5.0
+        # jsonschema bounds on the subtree catch what the whole-file
+        # validation can't: serve is immutable, so the fresh file's serve
+        # block validates, but the graft is per-key and must re-check
+        doc["serve"]["read"]["max_freshness_wait_s"] = 9.0
+        path.write_text(json.dumps(doc))
+        applied = cfg.reload()
+        assert "serve.read.max_freshness_wait_s" in applied
+        assert cfg.get("serve.read.max_freshness_wait_s") == 9.0
+
+
+class _SplitEngine:
+    """Split-phase engine for reconfigure drills (mirrors the
+    test_faults.py stand-in)."""
+
+    def pipeline_supported(self):
+        return True
+
+    def encode_batch(self, requests, max_depth=0, depths=None):
+        return _Enc(requests)
+
+    def launch_encoded(self, enc):
+        return enc
+
+    def decode_launched(self, launched):
+        return [True] * len(launched.requests)
+
+    def batch_check(self, requests, max_depth=0, depths=None):
+        return [True] * len(requests)
+
+
+class _Enc:
+    version = 0
+
+    def __init__(self, requests):
+        self.requests = list(requests)
+
+    def keys(self):
+        return [(r.object, 0, 0) for r in self.requests]
+
+    def compact(self, keep):
+        self.requests = [self.requests[i] for i in keep]
+
+    def release(self):
+        pass
+
+
+def _tup(i: int = 0):
+    from keto_tpu.relationtuple.definitions import (
+        RelationTuple,
+        SubjectID,
+    )
+
+    return RelationTuple(
+        namespace="n", object=f"o{i}", relation="view",
+        subject=SubjectID(id="alice"),
+    )
+
+
+class TestBatcherReconfigure:
+    """The quiesce seam the pipeline_depth/encode_workers knobs ride."""
+
+    def test_resize_pipeline_serves_before_and_after(self):
+        from keto_tpu.engine.batcher import CheckBatcher
+
+        b = CheckBatcher(
+            _SplitEngine(), window_s=0, pipeline_depth=2, encode_workers=1
+        )
+        try:
+            assert b.pipelined is True
+            assert b.check(_tup()) is True
+            assert b.reconfigure(pipeline_depth=4, encode_workers=3)
+            assert b.pipeline_depth == 4 and b.encode_workers == 3
+            assert b.check(_tup(1)) is True
+            stats = b.pipeline_stats()
+            assert stats["pipeline_depth"] == 4
+            assert stats["encode_workers"] == 3
+        finally:
+            b.close()
+
+    def test_noop_reconfigure_returns_false(self):
+        from keto_tpu.engine.batcher import CheckBatcher
+
+        b = CheckBatcher(
+            _SplitEngine(), window_s=0, pipeline_depth=2, encode_workers=2
+        )
+        try:
+            assert b.reconfigure(pipeline_depth=2, encode_workers=2) is False
+            assert b.reconfigure() is False
+        finally:
+            b.close()
+
+    def test_serial_to_pipelined_transition(self):
+        from keto_tpu.engine.batcher import CheckBatcher
+
+        b = CheckBatcher(_SplitEngine(), window_s=0, pipeline_depth=0)
+        try:
+            assert b.pipelined is False
+            assert b.check(_tup()) is True
+            assert b.reconfigure(pipeline_depth=2, encode_workers=2)
+            assert b.pipelined is True
+            assert b.check(_tup(1)) is True
+        finally:
+            b.close()
+
+    def test_reconfigure_after_close_raises(self):
+        from keto_tpu.engine.batcher import BatcherClosed, CheckBatcher
+
+        b = CheckBatcher(_SplitEngine(), window_s=0, pipeline_depth=1)
+        b.close()
+        with pytest.raises(BatcherClosed):
+            b.reconfigure(pipeline_depth=2)
+
+
+@pytest.fixture(scope="module")
+def autotune_server():
+    from tests.test_api_server import ServerFixture
+
+    cfg = Config(
+        values={
+            "namespaces": [{"id": 1, "name": "n"}],
+            "log": {"level": "error"},
+            "serve": {
+                "read": {"port": 0, "host": "127.0.0.1"},
+                "write": {"port": 0, "host": "127.0.0.1"},
+            },
+            # interval far beyond the test runtime: the daemon thread
+            # exists but the test drives step() deterministically
+            "autotune": {
+                "enabled": True,
+                "interval_s": 600.0,
+                "min_requests": 10,
+            },
+        },
+        env={},
+    )
+    s = ServerFixture(cfg)
+    yield s
+    s.stop()
+
+
+class TestEndToEndVisibility:
+    """ISSUE acceptance: one knob move visible in the flight recorder
+    (kind=autotune), /debug/autotune, and keto_autotune_moves_total —
+    end to end through a live server."""
+
+    def test_move_visible_in_flight_debug_and_metrics(
+        self, autotune_server
+    ):
+        reg = autotune_server.registry
+        tuner = reg._autotuner
+        assert tuner is not None and tuner.snapshot()["running"]
+        # swap in a scripted ledger: the move decision is deterministic,
+        # but it lands on the REAL batcher/config/metrics/flight
+        ledger = _ScriptedLedger()
+        tuner._attribution = ledger
+        tuner._last = None
+        before_workers = reg._batcher.encode_workers
+        tuner.step()  # warmup
+        ledger.advance(100, 1.0, {"queue": 0.6})
+        ev = tuner.step()
+        assert ev["action"] == "move" and ev["knob"] == "encode_workers"
+        # the REAL component resized, and config agrees with it
+        assert reg._batcher.encode_workers == before_workers + 1
+        assert (
+            reg.config.get("engine.encode_workers")
+            == before_workers + 1
+        )
+        base = f"http://127.0.0.1:{autotune_server.read_port}"
+        # surface 1: the flight recorder
+        recs = httpx.get(
+            f"{base}/debug/flight", params={"n": 200}, timeout=30
+        ).json()["records"]
+        auto = [r for r in recs if r.get("kind") == "autotune"]
+        assert auto and auto[0]["knob"] == "encode_workers"
+        assert "queue" in auto[0]["before"]
+        # surface 2: /debug/autotune
+        doc = httpx.get(f"{base}/debug/autotune", timeout=30).json()
+        assert doc["enabled"] is True
+        assert doc["moves_total"] >= 1
+        assert doc["knobs"]["encode_workers"]["value"] == (
+            before_workers + 1
+        )
+        assert doc["history"][0]["action"] == "move"
+        # surface 3: the metrics plane
+        text = httpx.get(f"{base}/metrics", timeout=30).text
+        assert "keto_autotune_moves_total" in text
+        assert 'knob="encode_workers"' in text
+        assert "keto_autotune_knob_value" in text

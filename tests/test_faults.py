@@ -288,6 +288,51 @@ class TestPipelineStageWatchdog:
         eng.gate.set()
 
 
+class TestReconfigureDrill:
+    """ISSUE-18 satellite: a LIVE ``reconfigure()`` (the autotuner's
+    pipeline_depth/encode_workers seam) stalls in its drain window via
+    the ``batcher.reconfigure_stall`` fault — concurrent traffic must
+    neither error nor vanish: in-flight batches flush through the old
+    stages, queued requests survive into the rebuilt pipeline."""
+
+    def test_stalled_reconfigure_keeps_concurrent_traffic(self):
+        b = CheckBatcher(
+            _SplitEngine(), window_s=0, pipeline_depth=2, encode_workers=1
+        )
+        try:
+            assert b.check(_tup()) is True  # pipeline warm
+            FAULTS.arm_slow("batcher.reconfigure_stall", sleep_ms=150)
+            results, errs = [], []
+
+            def call(i):
+                try:
+                    results.append(b.check(_tup(i), timeout=10))
+                except Exception as e:  # pragma: no cover - failure path
+                    errs.append(e)
+
+            threads = [
+                threading.Thread(target=call, args=(i,), daemon=True)
+                for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            # the reconfigure races the in-flight checks AND stalls in
+            # its drain window while holding the quiesce flag
+            assert b.reconfigure(pipeline_depth=3, encode_workers=2)
+            for t in threads:
+                t.join(timeout=10)
+            assert FAULTS.fired("batcher.reconfigure_stall") == 1
+            assert errs == []
+            assert results == [True] * 8
+            assert b.pipeline_depth == 3 and b.encode_workers == 2
+            assert b.pipelined is True
+            # the rebuilt pipeline serves fresh traffic
+            assert b.check(_tup(99), timeout=10) is True
+            assert b.pipeline_stats()["batches_in_pipeline"] == 0
+        finally:
+            b.close()
+
+
 class TestLoadShedding:
     def test_queue_full_sheds_with_429_semantics(self):
         eng = _GateEngine()

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Autotune gate (tools/check.sh): the feedback controller against a
+scripted ledger with a KNOWN response surface.
+
+The in-process AutoTuner drives a synthetic serving system whose
+throughput is a deterministic function of its knob vector:
+
+- ``pipeline_depth`` has an interior optimum (too shallow starves the
+  device, too deep thrashes HBM) — the controller must climb to it,
+  overshoot once, revert, and then HOLD it (convergence + the revert
+  path exercised on one seeded run);
+- ``encode_workers`` helps monotonically up to its bound — the
+  controller must ride it to the bound and stop (bound discipline);
+- every applied value is recorded and checked against the declared
+  [lo, hi] — a single out-of-bounds write fails the gate;
+- a guard flip mid-run must freeze moves instantly and thaw cleanly.
+
+Exit 0 = all invariants hold; exit 1 with a reason otherwise. No server
+boot, no device, sub-second runtime: this is the cheap always-on CI
+proof that the controller logic converges and respects its rails.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from keto_tpu.engine.autotune import AutoTuner, Knob  # noqa: E402
+from keto_tpu.telemetry import MetricsRegistry  # noqa: E402
+
+
+class World:
+    """The scripted system under control: cumulative attribution
+    snapshots derived from the current knob vector each window."""
+
+    def __init__(self):
+        self.depth = 2
+        self.workers = 2
+        self.requests = 0
+        self.wall = 0.0
+        self.stage_s = {"launch": 0.0, "queue": 0.0, "kernel": 0.0}
+        self.applied: list[tuple[str, float]] = []
+
+    def throughput(self) -> float:
+        # interior optimum at depth=5 (steep enough that one overshoot
+        # step regresses past the 5% revert threshold), monotone gain in
+        # workers up to the bound
+        return (
+            1000.0
+            - 80.0 * (self.depth - 5) ** 2
+            + 30.0 * self.workers
+        )
+
+    def advance_window(self) -> None:
+        self.requests += int(self.throughput())
+        self.wall += 1.0
+        # launch dominates until depth settles, then queue's worker knob
+        # becomes the bottleneck (two-phase convergence)
+        if self.depth != 5:
+            self.stage_s["launch"] += 0.6
+            self.stage_s["queue"] += 0.2
+        else:
+            self.stage_s["queue"] += 0.6
+            self.stage_s["launch"] += 0.1
+        self.stage_s["kernel"] += 0.1
+
+    def snapshot(self) -> dict:
+        return {
+            "requests": self.requests,
+            "entries": self.requests,
+            "wall_s": round(self.wall, 6),
+            "attributed_s": round(sum(self.stage_s.values()), 6),
+            "unattributed_s": 0.0,
+            "coverage": 1.0,
+            "stages": {
+                s: {"seconds": round(v, 6), "share_of_wall": 0.0}
+                for s, v in self.stage_s.items()
+            },
+        }
+
+
+def fail(msg: str) -> None:
+    print(f"autotune gate: FAIL: {msg}")
+    sys.exit(1)
+
+
+def main() -> int:
+    world = World()
+
+    def set_depth(v):
+        world.applied.append(("pipeline_depth", v))
+        world.depth = int(v)
+
+    def set_workers(v):
+        world.applied.append(("encode_workers", v))
+        world.workers = int(v)
+
+    depth_knob = Knob(
+        "pipeline_depth", stage="launch", lo=1, hi=8, step=1,
+        read=lambda: world.depth, apply=set_depth,
+    )
+    worker_knob = Knob(
+        "encode_workers", stage="queue", lo=1, hi=6, step=1,
+        read=lambda: world.workers, apply=set_workers,
+    )
+    guard = {"reason": None}
+    metrics = MetricsRegistry()
+    tuner = AutoTuner(
+        [depth_knob, worker_knob],
+        attribution=world,
+        metrics=metrics,
+        min_requests=10,
+        revert_threshold=0.05,
+        backoff_ticks=2,
+        guards=(lambda: guard["reason"],),
+    )
+
+    ticks = 60
+    for _ in range(ticks):
+        world.advance_window()
+        tuner.step()
+
+    # -- convergence ---------------------------------------------------------
+    if world.depth != 5:
+        fail(
+            f"pipeline_depth did not converge to the optimum 5 within "
+            f"{ticks} ticks (final={world.depth}, "
+            f"moves={tuner.moves_total}, reverts={tuner.reverts_total})"
+        )
+    if world.workers != 6:
+        fail(
+            f"encode_workers did not reach its bound 6 within {ticks} "
+            f"ticks (final={world.workers})"
+        )
+
+    # -- revert exercised ----------------------------------------------------
+    if tuner.reverts_total < 1:
+        fail(
+            "the overshoot past depth=5 was never reverted "
+            f"(reverts_total={tuner.reverts_total}) — the regression "
+            "detector is dead"
+        )
+    actions = [e["action"] for e in tuner.history()]
+    if "revert" not in actions:
+        fail("no revert event in the controller history")
+
+    # -- bounds never violated ----------------------------------------------
+    bounds = {"pipeline_depth": (1, 8), "encode_workers": (1, 6)}
+    for name, value in world.applied:
+        lo, hi = bounds[name]
+        if not (lo <= value <= hi):
+            fail(f"knob {name} applied out-of-bounds value {value}")
+
+    # -- freeze/thaw ---------------------------------------------------------
+    guard["reason"] = "breaker_open"
+    world.workers = 3  # re-open headroom so a move WOULD happen
+    moves_before = tuner.moves_total
+    world.advance_window()
+    ev = tuner.step()
+    if ev["action"] != "frozen" or tuner.moves_total != moves_before:
+        fail(f"guard did not freeze moves (event={ev})")
+    guard["reason"] = None
+    world.advance_window()
+    ev = tuner.step()
+    if ev["action"] != "move":
+        fail(f"controller did not thaw after the guard cleared ({ev})")
+
+    # -- metrics families present -------------------------------------------
+    text = metrics.expose()
+    for family in (
+        "keto_autotune_moves_total",
+        "keto_autotune_reverts_total",
+        "keto_autotune_knob_value",
+        "keto_autotune_frozen",
+    ):
+        if family not in text:
+            fail(f"metric family {family} missing from exposition")
+
+    print(
+        f"autotune gate: OK — converged depth=5 workers=6 in <= {ticks} "
+        f"ticks, moves={tuner.moves_total}, "
+        f"reverts={tuner.reverts_total}, {len(world.applied)} applies "
+        "all in bounds, freeze/thaw clean"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

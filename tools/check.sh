@@ -56,7 +56,12 @@
 #      full semiring rebuild (median-of-5 at m~2048); incremental D^T
 #      maintenance >= 5x over a full re-transpose; list_objects via the
 #      reverse index >= 10x over the per-candidate oracle scan
-#   9. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
+#   9. autotune gate — tools/autotune_gate.py: the in-process feedback
+#      controller against a scripted ledger with a known response
+#      surface: converge to the interior optimum, ride the monotone
+#      knob to its bound, exercise the revert path, never apply a
+#      value outside the declared bounds, freeze/thaw on a guard flip
+#  10. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
 #
 # Usage: bash tools/check.sh            (from the repo root)
 set -o pipefail
@@ -109,6 +114,11 @@ echo "== closure microbench gate =="
 # over a full re-transpose, and list_objects through the reverse index
 # >= 10x over the brute-force oracle; regressions exit non-zero here
 timeout -k 10 120 python tools/closure_microbench.py --gate || exit 1
+
+echo "== autotune gate =="
+# the online autotuner's controller logic, seeded + deterministic: must
+# converge, never leave the knob bounds, and exercise a revert
+timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/autotune_gate.py || exit 1
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

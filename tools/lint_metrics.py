@@ -207,6 +207,10 @@ def _run_live_gate() -> list[str]:
                 "scrape_interval_ms": 200,
                 "heartbeat_interval_ms": 200,
             },
+            # autotuner on (long interval: it must register its
+            # keto_autotune_* families for the lint, not actually move
+            # knobs mid-scrape)
+            "autotune": {"enabled": True, "interval_s": 600.0},
         },
         env={},
     )
