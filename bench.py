@@ -1759,6 +1759,7 @@ def _smoke_defaults() -> None:
         "BENCH_SHARDED_SERVING": "0",
         "BENCH_REPL_SECONDS": "2",
         "BENCH_AUTOTUNE_SECONDS": "3",
+        "BENCH_SCRUB_SECONDS": "3",
         "BENCH_BUDGET_S": "240",
         "BENCH_PROBE_TIMEOUT_S": "20",
         # cluster federation ON in the gate: the smoke numbers are
@@ -2520,6 +2521,178 @@ def run_autotune_bench() -> None:
     _heartbeat("autotune", autotuned_rps=summary["autotuned_rps"])
 
 
+def run_scrub_overhead_bench() -> None:
+    """The integrity scrubber's serving tax, measured on the REAL check
+    path: one warm ClosureCheckEngine + CheckBatcher under steady
+    multi-threaded load, with scrub duty cycles interleaved window-by-
+    window (off, on, off, on, ...) so clock drift and CPU noise cancel
+    instead of landing on one leg. During ON windows a ticker thread
+    runs ``ScrubDaemon.step()`` at a duty cycle well ABOVE the
+    production default (a step every ~0.5s vs the shipped 5s interval)
+    and the batcher's reservoir tap is attached — the measured fraction
+    is a conservative overestimate of the shipped config. Headline:
+    ``scrub_overhead_frac`` = 1 - on_rps/off_rps (clamped at 0);
+    --smoke gates it <= 0.02."""
+    import threading
+
+    from keto_tpu.engine import CheckEngine
+    from keto_tpu.engine.batcher import CheckBatcher
+    from keto_tpu.engine.closure import ClosureCheckEngine
+    from keto_tpu.engine.scrub import ScrubDaemon
+    from keto_tpu.graph.snapshot import SnapshotManager
+    from keto_tpu.relationtuple.definitions import (
+        RelationTuple,
+        SubjectID,
+        SubjectSet,
+    )
+    from keto_tpu.store.memory import InMemoryTupleStore
+    from keto_tpu.telemetry import MetricsRegistry
+
+    leg_seconds = float(os.environ.get("BENCH_SCRUB_SECONDS", 8))
+    n_threads = int(os.environ.get("BENCH_SCRUB_THREADS", 6))
+    tick_s = float(os.environ.get("BENCH_SCRUB_TICK", 0.5))
+    n_pairs = 6
+    window_s = leg_seconds / n_pairs
+
+    # same rbac-shaped store as the autotune leg: multi-hop BFS checks,
+    # sub-second build
+    n_users, n_groups, n_roles, n_resources = 64, 8, 4, 200
+    rng = np.random.default_rng(29)
+    tuples = []
+    for u in range(n_users):
+        for g in rng.choice(n_groups, 2, replace=False):
+            tuples.append(
+                RelationTuple("rbac", f"g{g}", "member", SubjectID(f"u{u}"))
+            )
+    for g in range(n_groups):
+        tuples.append(
+            RelationTuple(
+                "rbac", f"role{g % n_roles}", "member",
+                SubjectSet("rbac", f"g{g}", "member"),
+            )
+        )
+    for res in range(n_resources):
+        tuples.append(
+            RelationTuple(
+                "rbac", f"res{res}", "view",
+                SubjectSet("rbac", f"role{res % n_roles}", "member"),
+            )
+        )
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(*tuples)
+    engine = ClosureCheckEngine(SnapshotManager(store), max_depth=5)
+    oracle = CheckEngine(store, max_depth=5)
+    reqs = [
+        RelationTuple(
+            "rbac", f"res{int(rng.integers(n_resources))}", "view",
+            SubjectID(f"u{int(rng.integers(n_users))}"),
+        )
+        for _ in range(512)
+    ]
+
+    batcher = CheckBatcher(
+        engine, max_batch=128, window_s=0.0005,
+        metrics=MetricsRegistry(), pipeline_depth=2, encode_workers=2,
+    )
+    daemon = ScrubDaemon(
+        engine_fn=lambda: engine,
+        store_fn=lambda: store,
+        oracle_fn=lambda: oracle,
+        version_fn=lambda: store.version,
+        interval_s=999.0,  # stepped by the ticker below, never self-timed
+        seed=29,
+    )
+
+    done = 0
+    errors = 0
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def _worker(wid: int) -> None:
+        nonlocal done, errors
+        i = wid
+        while not stop.is_set():
+            try:
+                batcher.check(reqs[i % len(reqs)], timeout=30)
+            except Exception:
+                with lock:
+                    errors += 1
+                continue
+            i += n_threads
+            with lock:
+                done += 1
+
+    workers = [
+        threading.Thread(target=_worker, args=(w,), daemon=True)
+        for w in range(n_threads)
+    ]
+    for th in workers:
+        th.start()
+
+    def _measure_window(scrub_on: bool) -> float:
+        ticker_stop = threading.Event()
+        ticker = None
+        if scrub_on:
+            batcher.scrub_observer = daemon.observe_batch
+
+            def _tick() -> None:
+                while not ticker_stop.wait(tick_s):
+                    daemon.step()
+
+            ticker = threading.Thread(target=_tick, daemon=True)
+            ticker.start()
+        before = done
+        t0 = time.monotonic()
+        time.sleep(window_s)
+        dt = time.monotonic() - t0
+        if scrub_on:
+            ticker_stop.set()
+            ticker.join(timeout=10)
+            batcher.scrub_observer = None
+        return (done - before) / max(dt, 1e-9)
+
+    # two warm windows (bucket compiles + thread spin-up), discarded
+    _measure_window(False)
+    _measure_window(True)
+    off_rps: list[float] = []
+    on_rps: list[float] = []
+    for _ in range(n_pairs):
+        off_rps.append(_measure_window(False))
+        on_rps.append(_measure_window(True))
+    stop.set()
+    for th in workers:
+        th.join(timeout=10)
+    batcher.close()
+
+    off_mean = sum(off_rps) / max(len(off_rps), 1)
+    on_mean = sum(on_rps) / max(len(on_rps), 1)
+    frac = max(0.0, 1.0 - on_mean / max(off_mean, 1e-9))
+    summary = {
+        "seconds_per_mode": round(leg_seconds, 2),
+        "threads": n_threads,
+        "window_pairs": n_pairs,
+        "checks_total": done,
+        "check_errors": errors,
+        "scrub_off_rps": round(off_mean, 1),
+        "scrub_on_rps": round(on_mean, 1),
+        "scrub_overhead_frac": round(frac, 4),
+        "scrub_cycles": daemon.cycles,
+        "scrub_mismatches": dict(daemon.mismatches),
+        "scrub_repairs": dict(daemon.repairs),
+    }
+    print(
+        json.dumps({"config": "scrub_overhead", **summary}),
+        file=sys.stderr,
+        flush=True,
+    )
+    _EXTRA_HEADLINE["scrub_overhead"] = summary
+    _EXTRA_HEADLINE["scrub_overhead_frac"] = summary["scrub_overhead_frac"]
+    _heartbeat(
+        "scrub_overhead",
+        scrub_overhead_frac=summary["scrub_overhead_frac"],
+    )
+
+
 def run_sharded_serving_bench(name: str) -> None:
     """Subprocess wrapper for _sharded_serving_child: JSON rungs land on
     stderr AND in the headline's ``sharded_serving`` list, and the best
@@ -3066,6 +3239,23 @@ def main():
                 flush=True,
             )
 
+    if os.environ.get("BENCH_SCRUB", "1") == "1" and not _skip_phase(
+        "scrub_overhead", 45.0
+    ):
+        try:
+            run_scrub_overhead_bench()
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            print(
+                json.dumps(
+                    {"config": "scrub_overhead", "error": repr(e)[:300]}
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+
     if os.environ.get("BENCH_SHARDED", "1") == "1" and not _skip_phase(
         "sharded", 120.0
     ):
@@ -3260,6 +3450,29 @@ def main():
                 flush=True,
             )
             sys.exit(3)
+        # scrub overhead gate: the always-on integrity scrubber, at a
+        # duty cycle ABOVE the production default, must cost at most 2%
+        # of steady-state check throughput — an expensive scrub check
+        # leaking onto the serving path fails here
+        so = _EXTRA_HEADLINE.get("scrub_overhead") or {}
+        if so.get("scrub_off_rps") and (
+            so.get("scrub_overhead_frac", 0.0) > 0.02
+        ):
+            print(
+                json.dumps(
+                    {
+                        "gate": "scrub_overhead",
+                        "scrub_overhead_frac": so.get("scrub_overhead_frac"),
+                        "max_frac": 0.02,
+                        "scrub_off_rps": so.get("scrub_off_rps"),
+                        "scrub_on_rps": so.get("scrub_on_rps"),
+                        "scrub_cycles": so.get("scrub_cycles"),
+                    }
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+            sys.exit(3)
 
 
 def _load_prev_headline() -> tuple[str, dict] | None:
@@ -3304,6 +3517,7 @@ _HIGHER_BETTER = (
     "autotuned_rps",
 )
 _LOWER_BETTER = (
+    "scrub_overhead_frac",
     "batch_p95_ms",
     "expand_p95_ms",
     "staleness_p95_ms",

@@ -21,6 +21,8 @@ Routes (all under /debug, read port only):
   capture when the profiler is not already running
 - ``/debug/device``   device-fault plane: serving backend, breaker +
   quarantined shapes, last failover timeline, HBM budget headroom
+- ``/debug/scrub``    integrity plane: scrub cycle/mismatch/repair
+  totals, last-clean version, freeze reason, newest-first cycle history
 - ``/debug/cluster``  fleet view: the federation scraper's full status
   (per-member health rollup + scrape/heartbeat internals), leader only
 
@@ -127,6 +129,7 @@ class DebugContext:
         cluster=None,
         instance_id: str = "",
         autotune_fn=None,
+        scrub_fn=None,
     ):
         self.config = config
         self.flight = flight
@@ -159,6 +162,9 @@ class DebugContext:
         # AutoTuner (None until autotune.enabled builds one) — a getter
         # because /debug/autotune must observe, never construct
         self.autotune_fn = autotune_fn
+        # integrity plane: same getter discipline for the ScrubDaemon
+        # (None until scrub.enabled builds one)
+        self.scrub_fn = scrub_fn
 
 
 class DebugAPI:
@@ -175,6 +181,7 @@ class DebugAPI:
         app.router.add_get("/debug/profile", self.get_profile)
         app.router.add_get("/debug/attribution", self.get_attribution)
         app.router.add_get("/debug/autotune", self.get_autotune)
+        app.router.add_get("/debug/scrub", self.get_scrub)
         app.router.add_get("/debug/pprof", self.get_pprof)
         app.router.add_get("/debug/device", self.get_device)
         app.router.add_get("/debug/cluster", self.get_cluster)
@@ -455,6 +462,30 @@ class DebugAPI:
             n = 50
         payload = tuner.snapshot()
         payload["history"] = tuner.history(n)
+        return web.json_response(payload, dumps=_dumps)
+
+    async def get_scrub(self, request: web.Request) -> web.Response:
+        """The integrity scrubber's state: cycle/mismatch/repair totals
+        by kind and action, last-clean version, reservoir fill, freeze
+        reason, and the newest-first cycle history (``?n=`` caps it,
+        default 50) — the page to pull when
+        keto_scrub_mismatches_total moves."""
+        self._gate(request)
+        daemon = (
+            self.ctx.scrub_fn()
+            if self.ctx.scrub_fn is not None
+            else None
+        )
+        if daemon is None:
+            return web.json_response(
+                {"enabled": False, "running": False}, dumps=_dumps
+            )
+        try:
+            n = int(request.rel_url.query.get("n", 50))
+        except ValueError:
+            n = 50
+        payload = daemon.snapshot()
+        payload["history"] = daemon.history(n)
         return web.json_response(payload, dumps=_dumps)
 
     async def get_device(self, request: web.Request) -> web.Response:

@@ -521,6 +521,43 @@ CONFIG_SCHEMA = {
             },
             "additionalProperties": False,
         },
+        # integrity plane (engine/scrub.py): continuous online scrubbing
+        # of derived state — device-resident closure rows, replayed live
+        # checks, sealed WAL segments, checkpoint digests, and follower
+        # anti-entropy — with a rate-limited repair ladder. Like the
+        # autotuner, the kill switch is hot-reloadable and all repairs
+        # freeze while the SLO is burning
+        "scrub": {
+            "type": "object",
+            "properties": {
+                "enabled": {"type": "boolean"},
+                # scrub cycle cadence — the duty-cycle budget: each cycle
+                # does a bounded slice of verification work, then sleeps
+                "interval_s": {"type": "number", "exclusiveMinimum": 0},
+                # device-resident closure rows re-derived per cycle
+                "sample_rows": {"type": "integer", "minimum": 1},
+                # recent live check requests retained for replay
+                "reservoir": {"type": "integer", "minimum": 1},
+                # reservoir entries replayed through the host oracle per
+                # cycle (0 disables the replay pass)
+                "replay_per_cycle": {"type": "integer", "minimum": 0},
+                # sealed WAL segments CRC-rescanned per cycle, rolling
+                # cursor (0 disables the WAL pass)
+                "wal_segments_per_cycle": {"type": "integer", "minimum": 0},
+                # repair-ladder rate limit: repairs applied per cycle
+                # beyond this are deferred to the next cycle
+                "max_repairs_per_cycle": {"type": "integer", "minimum": 0},
+                # tuples per anti-entropy digest chunk (a divergent chunk
+                # localizes damage to about this many rows)
+                "digest_chunk_size": {"type": "integer", "minimum": 1},
+                # fast-window SLO burn rate at or above this freezes
+                # scrubbing (0 = inherit telemetry.slo.alert_burn_rate)
+                "freeze_burn_rate": {"type": "number", "minimum": 0},
+                # /debug/scrub history ring entries retained
+                "history": {"type": "integer", "minimum": 1},
+            },
+            "additionalProperties": False,
+        },
         # /debug surface on the read plane (api/debug.py)
         "debug": {
             "type": "object",
@@ -710,6 +747,16 @@ DEFAULTS = {
     "autotune.backoff_ticks": 3,
     "autotune.history": 256,
     "autotune.knobs": {},
+    "scrub.enabled": False,
+    "scrub.interval_s": 5.0,
+    "scrub.sample_rows": 64,
+    "scrub.reservoir": 256,
+    "scrub.replay_per_cycle": 32,
+    "scrub.wal_segments_per_cycle": 4,
+    "scrub.max_repairs_per_cycle": 2,
+    "scrub.digest_chunk_size": 1024,
+    "scrub.freeze_burn_rate": 0.0,
+    "scrub.history": 256,
     "debug.enabled": True,
     "debug.token": "",
     "debug.profile_max_s": 30,

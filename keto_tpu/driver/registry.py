@@ -285,6 +285,15 @@ class DeviceSupervisor:
             self._event("reinit_failed", backend=platform, error=str(e)[-200:])
             return False
 
+    def reset_residency(self) -> bool:
+        """Public quarantine + re-upload seam (the scrubber's device
+        repair): tear down residency and re-warm on the CURRENT backend —
+        no platform probing, no failover bookkeeping, just a clean
+        rebuild of everything device-resident."""
+        ok = self._reinit(self.backend)
+        self._event("scrub_reset_residency", backend=self.backend, ok=ok)
+        return ok
+
     # -- introspection ---------------------------------------------------------
 
     def _event(self, event: str, **fields) -> None:
@@ -379,6 +388,9 @@ class Registry:
         # online autotuner (engine/autotune.py): built lazily by
         # autotuner(), daemon started in start_all after any replica fork
         self._autotuner = None
+        # integrity scrubber (engine/scrub.py): built lazily by
+        # scrubber(), daemon started in start_all after any replica fork
+        self._scrubber = None
         # the reply-stage virtual knob: the hedge delay this server
         # currently advertises to clients (surfaced via /debug/autotune;
         # clients adopt it with HedgePolicy.advertise). Starts at the
@@ -682,6 +694,7 @@ class Registry:
                 # later (autotune.enabled flipped on by a hot reload) and
                 # /debug/autotune must never construct it as a side effect
                 autotune_fn=lambda: self._autotuner,
+                scrub_fn=lambda: self._scrubber,
                 cluster=self.federation(),
                 instance_id=(
                     self.cluster_instance_id()
@@ -836,6 +849,15 @@ class Registry:
             )
         except WalError as e:
             raise ErrMalformedInput(str(e)) from e
+        m_append_errors = self.metrics().counter(
+            "keto_wal_append_errors_total",
+            "WAL append failures (the write was NOT acked and the "
+            "durable wrapper fail-stopped), by errno",
+            labelnames=("errno",),
+        )
+        durable.append_error_cb = lambda err: m_append_errors.labels(
+            errno=str(err) if err is not None else "none"
+        ).inc()
         rep = durable.recovery
         log = self.logger()
         line = log.error if rep.gap else log.info
@@ -1448,6 +1470,120 @@ class Registry:
                 guards=(_breaker_guard, _hbm_guard),
             )
         return self._autotuner
+
+    def scrubber(self):
+        """The integrity scrubber (engine/scrub.py), wired to every
+        derived-state surface this node carries: the serving engine's
+        residency, the batcher's live-check tap + result caches, the
+        durable store's WAL/checkpoints, and (on followers) the
+        replication anti-entropy digest. Built lazily — construction
+        builds the checker; the daemon thread starts in start_all."""
+        if self._scrubber is None:
+            from ..engine.scrub import ScrubDaemon
+
+            cfg = self.config
+            self.checker()  # engine + batcher + breaker exist after this
+            store = self.store()
+
+            def _engine():
+                return self._check_engine
+
+            def _oracle():
+                eng = self._check_engine
+                fb = getattr(eng, "fallback_engine", None)
+                return fb() if fb is not None else None
+
+            def _repair():
+                # the remediation ladder's quarantine + re-upload rung:
+                # prefer the supervisor (re-warm + breaker probe); fall
+                # back to the engine's bare reset_residency
+                sup = self._device_supervisor
+                if sup is not None:
+                    sup.reset_residency()
+                    return
+                eng = self._check_engine
+                reset = getattr(eng, "reset_residency", None)
+                if reset is not None:
+                    reset()
+
+            def _flush_caches():
+                b = self._batcher
+                if b is None:
+                    return
+                for c in (b.cache, b.encoded_cache):
+                    if c is not None:
+                        c.clear()
+
+            def _breaker_guard():
+                b = self._engine_breaker
+                if b is None:
+                    return None
+                try:
+                    if b.breaker_snapshot()["open"]:
+                        return "breaker_open"
+                except Exception:
+                    pass
+                return None
+
+            def _hbm_guard():
+                h = self._hbm_admission
+                if h is None:
+                    return None
+                try:
+                    snap = h.snapshot()
+                    if (
+                        snap.get("headroom_bytes", 1) <= 0
+                        and snap.get("inflight_bytes", 0) > 0
+                    ):
+                        return "hbm_pressure"
+                except Exception:
+                    pass
+                return None
+
+            self._scrubber = ScrubDaemon(
+                engine_fn=_engine,
+                store_fn=lambda: store,
+                oracle_fn=_oracle,
+                replicator_fn=lambda: self._replicator,
+                repair_fn=_repair,
+                cache_flush_fn=_flush_caches,
+                version_fn=self._answering_version,
+                slo=self.slo(),
+                metrics=self.metrics(),
+                flight=self.flight(),
+                logger=self.logger(),
+                interval_s=float(
+                    cfg.get("scrub.interval_s", default=5.0)
+                ),
+                sample_rows=int(
+                    cfg.get("scrub.sample_rows", default=64)
+                ),
+                reservoir=int(cfg.get("scrub.reservoir", default=256)),
+                replay_per_cycle=int(
+                    cfg.get("scrub.replay_per_cycle", default=32)
+                ),
+                wal_segments_per_cycle=int(
+                    cfg.get("scrub.wal_segments_per_cycle", default=4)
+                ),
+                max_repairs_per_cycle=int(
+                    cfg.get("scrub.max_repairs_per_cycle", default=2)
+                ),
+                digest_chunk_size=int(
+                    cfg.get("scrub.digest_chunk_size", default=1024)
+                ),
+                freeze_burn_rate=float(
+                    cfg.get("scrub.freeze_burn_rate", default=0.0)
+                ),
+                history=int(cfg.get("scrub.history", default=256)),
+                enabled_fn=lambda: bool(
+                    cfg.get("scrub.enabled", default=False)
+                ),
+                guards=(_breaker_guard, _hbm_guard),
+            )
+            if self._batcher is not None:
+                # tap finished live batches into the replay reservoir
+                self._batcher.scrub_observer = self._scrubber.observe_batch
+        return self._scrubber
 
     def encoded_front(self):
         """The id-native check tier (api/encoded.py): epoch gate + id
@@ -2450,6 +2586,12 @@ class Registry:
             # freezes it in place (every tick short-circuits); flipping it
             # ON later is handled by the config watcher
             self.autotuner().start()
+        if bool(self.config.get("scrub.enabled", default=False)):
+            # the integrity scrubber thread: same after-the-fork rule.
+            # scrub.enabled off via hot reload freezes it (every cycle
+            # short-circuits); flipping it ON later is handled by the
+            # config watcher
+            self.scrubber().start()
         self.health.set_serving(True)  # readiness flips only after bring-up
         log.info(
             "serving",
@@ -2643,6 +2785,16 @@ class Registry:
                             log.warn(
                                 "autotuner start failed", error=str(e)
                             )
+                    if "scrub" in applied and bool(
+                        self.config.get("scrub.enabled", default=False)
+                    ):
+                        # same contract as the autotuner above
+                        try:
+                            self.scrubber().start()
+                        except Exception as e:
+                            log.warn(
+                                "scrubber start failed", error=str(e)
+                            )
                     if "tracing" in applied and self._tracer is not None:
                         self._tracer.reconfigure(
                             str(
@@ -2715,6 +2867,11 @@ class Registry:
             # race reconfigure() against close()
             self._autotuner.stop()
             self._autotuner = None
+        if self._scrubber is not None:
+            # before the batcher close for the same reason: a mid-shutdown
+            # repair must not race reset_residency() against close()
+            self._scrubber.stop()
+            self._scrubber = None
         if self._config_watcher is not None:
             self._config_watch_stop.set()
             self._config_watcher.join(timeout=5)

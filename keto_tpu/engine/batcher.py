@@ -225,6 +225,10 @@ class CheckBatcher:
             )
             if self.pipelined:
                 self._m_stage = pipeline_stage_histogram(metrics)
+        # integrity-scrub tap (engine/scrub.py ScrubDaemon.observe_batch):
+        # called with (requests, results) after each direct dispatch so
+        # the scrubber can reservoir-sample live traffic for oracle replay
+        self.scrub_observer = None
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         # (request, depth, Future, t_enqueued, deadline, ledger, span_ctx)
@@ -502,12 +506,20 @@ class CheckBatcher:
             with self.tracer.span(
                 "batcher.dispatch", batch_size=len(requests)
             ):
-                return dispatch_batched(
+                res = dispatch_batched(
                     self.engine, requests, max_depth, self._admit_rows()
                 )
-        return dispatch_batched(
-            self.engine, requests, max_depth, self._admit_rows()
-        )
+        else:
+            res = dispatch_batched(
+                self.engine, requests, max_depth, self._admit_rows()
+            )
+        obs = self.scrub_observer
+        if obs is not None:
+            try:
+                obs(requests, res)
+            except Exception:
+                pass  # a broken scrub tap must never fail live checks
+        return res
 
     def check_batch_columnar(
         self,
@@ -1125,6 +1137,12 @@ class CheckBatcher:
                 f = item[2]
                 if not f.done():
                     f.set_result(bool(allowed))
+            obs = self.scrub_observer
+            if obs is not None:
+                try:
+                    obs(requests, results)
+                except Exception:
+                    pass  # a broken scrub tap must never fail live checks
             with self._cv:
                 self._inflight = []
 
@@ -1387,6 +1405,20 @@ class CheckBatcher:
                 led.mark("decode")
             if allowed is not None and not f.done():
                 f.set_result(bool(allowed))
+        obs = self.scrub_observer
+        if obs is not None:
+            # rows the fallback skipped as already-dead carry None; only
+            # real answers are replay candidates
+            pairs = [
+                (item[0], v)
+                for item, v in zip(batch.items, results)
+                if v is not None
+            ]
+            if pairs:
+                try:
+                    obs([p[0] for p in pairs], [p[1] for p in pairs])
+                except Exception:
+                    pass  # a broken scrub tap must never fail live checks
         if self.encoded_cache is not None and batch.keys is not None:
             # a None result marks a row the fallback skipped as
             # already-dead: nothing to cache for it

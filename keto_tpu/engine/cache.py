@@ -113,6 +113,14 @@ class CheckResultCache:
             while len(entries) > self.capacity:
                 entries.popitem(last=False)
 
+    def clear(self) -> None:
+        """Drop every entry AND the version stamp (the scrubber's repair
+        seam: a poisoned answer may be cached under an unchanged version,
+        so a version bump alone would never evict it)."""
+        with self._lock:
+            self._entries.clear()
+            self._version = None
+
     def resize(self, capacity: int) -> None:
         """Hot-apply a new capacity (the autotuner's seam for
         engine.encoded_cache_size / engine.cache_size): shrinking trims

@@ -112,6 +112,43 @@ def _m_pad_for(m: int) -> int:
     return _bucket_mult(m + 1 + _GROW_RESERVE, 256)
 
 
+def _scrub_expected_rows(
+    adj_packed: np.ndarray,
+    rows: np.ndarray,
+    m_pad: int,
+    k_max: int,
+) -> np.ndarray:
+    """Host truth for a sampled set of closure rows: the same masked-SpMV
+    BFS as engine/semiring.py `_bfs_rows_into`, but writing into a compact
+    (n, m_pad) array so scrubbing a handful of rows never allocates the
+    full m_pad^2 matrix. Diagonal 0 for the (live) sampled rows, INF
+    elsewhere — byte-identical to the builder's contract."""
+    n = len(rows)
+    exp = np.full((n, m_pad), INF_DIST, dtype=np.uint8)
+    if n == 0:
+        return exp
+    frontier = adj_packed[rows].copy()
+    reached = frontier.copy()
+    k = 1
+    while True:
+        fb = np.unpackbits(frontier, axis=1)
+        rs, vs = np.nonzero(fb)
+        if rs.size == 0:
+            break
+        exp[rs, vs] = k
+        if k == k_max:
+            break
+        k += 1
+        nxt = np.zeros_like(frontier)
+        np.bitwise_or.at(nxt, rs, adj_packed[vs])
+        frontier = nxt & ~reached
+        reached |= frontier
+    # diagonal last, exactly like build_closure_bitset: a cycle's BFS
+    # distance back to the source is overwritten by the 0 self-distance
+    exp[np.arange(n), rows] = 0
+    return exp
+
+
 def _probe_roundtrip_slow() -> bool:
     """Tiny H2D+D2H round trips; True when the link is latency-bound
     (networked accelerator) and per-batch device queries would drown in
@@ -889,6 +926,92 @@ class ClosureCheckEngine:
             while b <= top:
                 self.batch_check([dummy] * b)
                 b *= 2
+
+    # -- integrity scrubbing (engine/scrub.py) ---------------------------------
+
+    def reset_residency(self) -> None:
+        """Drop the resident closure (D, the lazy D^T, and the write
+        overlay) and rebuild synchronously from the store — the
+        scrubber's quarantine + re-upload seam, and the device
+        supervisor's post-failover teardown."""
+        with self._build_lock:
+            self._state = None
+            self._overlay = None
+        self._build_sync()
+
+    def scrub_residency(self, sample_rows: int = 64, rng=None):
+        """Verify a random sample of resident closure rows against host
+        truth (the same masked-SpMV BFS the semiring builder runs over
+        the snapshot's interior adjacency). Returns a report dict, or
+        None when there is nothing scrubbable right now:
+
+        - no resident closure (too-big/fallback state or not built), or
+        - the residency is not quiescent — the state lags the live store
+          version or the write overlay holds absorbed corrections. The
+          overlay patches D in place *by design*, so a patched D
+          diverging from the pure snapshot closure is not corruption;
+          scrubbing resumes after the next rebuild folds it in.
+
+        The ``scrub.device_bitflip`` fault site fires here: it poisons
+        one element of the actual serving copy (host or device), so a
+        drill proves the sampled comparison really detects — and the
+        repair really restores — the serving buffer."""
+        state = self._state
+        if not isinstance(state, _ClosureArtifacts):
+            return None
+        if state.version != self.snapshots.store.version:
+            return None
+        ov = self._overlay
+        if ov is not None and ov.art is state:
+            ov.drain()
+            if ov.n_events or ov.broken:
+                return None
+        ig, m_pad = state.ig, state.m_pad
+        if ig.m == 0:
+            return {"sampled": 0, "version": state.version,
+                    "bad_rows": [], "bad_rev_rows": []}
+        if rng is None:
+            rng = np.random.default_rng()
+        from ..faults import FAULTS
+
+        if FAULTS.should_fire("scrub.device_bitflip"):
+            r = int(rng.integers(ig.m))
+            c = int(rng.integers(m_pad))
+            if state.d_host is not None:
+                cur = int(state.d_host[r, c])
+                state.d_host[r, c] = 0 if cur else 1
+            else:
+                cur = int(np.asarray(state.d[r, c]))
+                state.d = state.d.at[r, c].set(0 if cur else 1)
+        n = min(max(1, int(sample_rows)), ig.m)
+        rows = np.sort(
+            rng.choice(ig.m, size=n, replace=False).astype(np.int64)
+        )
+        packed = pack_adjacency(ig.ii_src, ig.ii_dst, m_pad)
+        expected = _scrub_expected_rows(packed, rows, m_pad, state.k_max)
+        if state.d_host is not None:
+            served = state.d_host[rows]
+        else:
+            served = np.asarray(state.d[rows])
+        diff = np.any(served != expected, axis=1)
+        bad_rows = [int(r) for r in rows[diff]]
+        # cross-check the transposed residency when the list path built
+        # it: D^T[:, r] must equal D's recomputed row r
+        bad_rev_rows: list[int] = []
+        if state.d_rev is not None:
+            if isinstance(state.d_rev, np.ndarray):
+                rev_rows = state.d_rev[:, rows].T
+            else:
+                rev_rows = np.asarray(state.d_rev[:, rows]).T
+            rev_diff = np.any(rev_rows != expected, axis=1)
+            bad_rev_rows = [int(r) for r in rows[rev_diff]]
+        return {
+            "sampled": int(n),
+            "version": state.version,
+            "resident": "host" if state.d_host is not None else "device",
+            "bad_rows": bad_rows,
+            "bad_rev_rows": bad_rev_rows,
+        }
 
     def device_view(self) -> "ClosureCheckEngine":
         """A second engine over the same snapshots serving the SAME

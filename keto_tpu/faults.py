@@ -91,6 +91,21 @@ site                      effect when armed
                           mid-failover; the lease must be released and the
                           election re-run instead of wedging the fleet
                           read-only (cluster/election.py)
+``scrub.device_bitflip``  one element of the resident closure matrix is
+                          poisoned in place — the silent HBM bit flip the
+                          row scrubber must detect and repair via
+                          ``reset_residency`` (engine/closure.py)
+``wal.bitrot``            one byte of a *sealed* WAL segment flips on disk;
+                          the scrubber's rolling CRC rescan must flag it and
+                          checkpoint past the damage (store/wal.py,
+                          fired from engine/scrub.py)
+``wal.enospc``            a WAL append raises ENOSPC before any byte lands;
+                          the write is never acked and the durable wrapper
+                          fail-stops (store/wal.py)
+``replica.skip_delta``    a follower applies a delta's version but drops its
+                          tuples — silent divergence with zero reported lag;
+                          only the anti-entropy digest can see it
+                          (replication/follower.py)
 ========================  ====================================================
 
 Slowness sites (armed with :meth:`FaultRegistry.arm_slow`, consumed with

@@ -32,6 +32,7 @@ raises before the rename — the atomicity claim under test.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
@@ -59,6 +60,23 @@ _POOLS = ("ns", "obj", "rel", "sid")
 
 class CheckpointError(RuntimeError):
     pass
+
+
+def _payload_sha256(arrays: dict) -> str:
+    """Digest of every payload array (name-sorted, ``meta`` excluded —
+    the digest lives inside meta, so meta cannot cover itself). The hash
+    binds names, shapes, dtypes, and bytes: a renamed or reshaped array
+    is damage, not a collision."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        if name == "meta":
+            continue
+        a = np.ascontiguousarray(arrays[name])
+        h.update(name.encode("utf-8"))
+        h.update(str(a.dtype).encode("utf-8"))
+        h.update(str(a.shape).encode("utf-8"))
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 
 def _pack_strings(strings: list[str]) -> tuple[np.ndarray, str]:
@@ -228,6 +246,7 @@ def write_checkpoint(
         meta["csr_version"] = (
             int(csr_version) if csr_version is not None else meta["version"]
         )
+    meta["sha256"] = _payload_sha256(arrays)
     meta_blob = json.dumps(meta, separators=(",", ":")).encode("utf-8")
     arrays["meta"] = np.frombuffer(meta_blob, dtype=np.uint8)
 
@@ -284,6 +303,14 @@ class Checkpoint:
     _npz: object
     csr: Optional[tuple[np.ndarray, np.ndarray]] = None
     csr_version: Optional[int] = None
+
+    def close(self) -> None:
+        """Release the underlying npz file handle (verify-only readers —
+        the scrubber, keto doctor — open many checkpoints and must not
+        leak descriptors)."""
+        close = getattr(self._npz, "close", None)
+        if close is not None:
+            close()
 
     def restore_into(self, store) -> None:
         """Overwrite ``store`` (same kind it was written from) with the
@@ -404,6 +431,22 @@ def load_checkpoint(path: str) -> Checkpoint:
     kind = meta.get("kind")
     if kind not in ("memory", "columnar"):
         raise CheckpointError(f"unknown checkpoint kind in {path}: {kind!r}")
+    want = meta.get("sha256")
+    if want is not None:
+        # pre-sha256 checkpoints (no field) load as before; a checkpoint
+        # that CLAIMS a digest must match it — a half-trusted checkpoint
+        # never boots silently
+        try:
+            got = _payload_sha256({n: npz[n] for n in npz.files})
+        except Exception as e:
+            raise CheckpointError(
+                f"unreadable checkpoint payload {path}: {e}"
+            ) from e
+        if got != want:
+            raise CheckpointError(
+                f"checkpoint {path} failed sha256 verification: "
+                f"meta says {want}, payload hashes to {got}"
+            )
     csr = None
     csr_version = None
     if "csr_indptr" in getattr(npz, "files", ()):

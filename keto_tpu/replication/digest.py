@@ -1,0 +1,82 @@
+"""Anti-entropy digests: per-chunk rolling hash of columnar state.
+
+Replication ships deltas; nothing downstream ever re-proves that a
+follower's materialized state still equals the leader's. A follower that
+silently skipped a delta (``replica.skip_delta``) keeps polling, keeps
+advancing its applied version, and serves wrong answers forever — the
+classic anti-entropy gap Dynamo-style systems close with Merkle exchange.
+
+This module is the cheap version of that exchange, shaped for the repo's
+stores: the canonical row serialization already exists
+(:func:`keto_tpu.store.wal.encode_tuple` — explicit fields, no
+string-grammar round trip), so a digest is
+
+    sort all live tuples by their encoded spelling
+    split the sorted list into fixed-size chunks
+    sha256 each chunk
+
+Two stores at the SAME applied version must produce identical chunk
+lists; a divergent chunk localizes the damage to ~``chunk_size`` rows.
+Version must be compared by the caller first — comparing digests across
+versions reports lag as divergence, which is exactly the false positive
+an anti-entropy loop must not page on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from ..store.wal import encode_tuple
+
+DIGEST_ALGO = "sha256"
+
+
+def compute_digest(store, chunk_size: int = 1024) -> dict:
+    """Digest ``store``'s live tuples at its current version.
+
+    Uses the store's ``snapshot()`` surface when present (one lock
+    acquisition, version and tuples observed atomically); falls back to
+    ``all_tuples()`` + ``version`` for bare stores in tests.
+    """
+    chunk_size = max(1, int(chunk_size))
+    snap = getattr(store, "snapshot", None)
+    if snap is not None:
+        tuples, version = snap()
+    else:
+        tuples = store.all_tuples()
+        version = store.version
+    rows = sorted(
+        json.dumps(encode_tuple(t), separators=(",", ":"), sort_keys=True)
+        for t in tuples
+    )
+    chunks = []
+    for i in range(0, len(rows), chunk_size):
+        h = hashlib.sha256()
+        for row in rows[i: i + chunk_size]:
+            h.update(row.encode("utf-8"))
+            h.update(b"\n")
+        chunks.append(h.hexdigest())
+    return {
+        "version": int(version),
+        "algo": DIGEST_ALGO,
+        "chunk_size": chunk_size,
+        "count": len(rows),
+        "chunks": chunks,
+    }
+
+
+def diff_digests(local: dict, remote: dict) -> list[int]:
+    """Indices of divergent chunks between two digests computed at the
+    same version and chunk size. A length mismatch marks every index in
+    the longer list from the first differing position."""
+    a = local.get("chunks", [])
+    b = remote.get("chunks", [])
+    n = max(len(a), len(b))
+    out = []
+    for i in range(n):
+        av = a[i] if i < len(a) else None
+        bv = b[i] if i < len(b) else None
+        if av != bv:
+            out.append(i)
+    return out

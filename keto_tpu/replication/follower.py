@@ -42,6 +42,7 @@ import urllib.parse
 import urllib.request
 from typing import Optional
 
+from ..faults import FAULTS
 from ..graph import checkpoint as ckpt_mod
 from ..store.wal import WriteAheadLog, record_from_doc
 from ..utils.errors import ErrFollowerLag
@@ -180,6 +181,23 @@ class FollowerReplicator:
         with self._cv:
             self._cv.notify_all()
 
+    def reseed(self) -> None:
+        """Public re-bootstrap seam: throw the local state away and
+        re-seed from the leader's newest checkpoint. The scrubber's
+        anti-entropy repair for a digest-divergent follower."""
+        self._reseed()
+        self._cursor = [0, 0]
+
+    # -- anti-entropy ---------------------------------------------------------
+
+    def fetch_digest(self, chunk_size: int = 1024) -> dict:
+        """The leader's per-chunk state digest (``/replication/digest``).
+        Compare against ``compute_digest(self.store, ...)`` only at the
+        same version — lag is not divergence."""
+        return self._get_json(
+            "/replication/digest", {"chunk_size": int(chunk_size)}
+        )
+
     # -- tail loop ------------------------------------------------------------
 
     def start(self) -> None:
@@ -248,6 +266,13 @@ class FollowerReplicator:
             if rec.kind == "bulk":
                 if rec.version > self.store.version:
                     self._reseed()
+                continue
+            if FAULTS.should_fire("replica.skip_delta"):
+                # silent divergence: the version advances but the delta's
+                # tuples never land — exactly the damage only the
+                # anti-entropy digest can see (lag stays 0)
+                if self.store.apply_replicated_delta(rec.version, [], []):
+                    applied += 1
                 continue
             if self.store.apply_replicated_delta(
                 rec.version, rec.inserted, rec.deleted

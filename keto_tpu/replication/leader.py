@@ -2,8 +2,8 @@
 
 The leader's durable write plane (store/durable.py) already persists
 everything a replica needs: an atomic checkpoint of the full store and a
-segmented WAL of every delta since. This module serves both over three
-routes mounted on the write plane's REST app (the write plane is the
+segmented WAL of every delta since. This module serves both over routes
+mounted on the write plane's REST app (the write plane is the
 natural home — replication is a consumer of the *write* log, and the
 read plane stays untouched on the leader):
 
@@ -21,6 +21,10 @@ read plane stays untouched on the leader):
   a cursor naming a *pruned* segment answers ``reset: true`` — the
   follower re-seeds from the checkpoint. ``wait_ms`` long-polls so a
   quiet leader doesn't force hot polling.
+- ``GET /replication/digest?chunk_size=N`` — per-chunk rolling sha256 of
+  the live tuple set at the leader's current version
+  (replication/digest.py). The scrubber's anti-entropy pass compares it
+  against the follower's local digest at the same applied version.
 
 Serving reads the segment files directly (shared-nothing with the append
 handle except the filesystem), reusing the WAL's own frame parser — the
@@ -41,6 +45,7 @@ from aiohttp import web
 
 from ..graph import checkpoint as ckpt_mod
 from ..store.wal import _FILE_MAGIC, _FRAME, _MAX_PAYLOAD, _list_segments
+from .digest import compute_digest
 
 log = logging.getLogger("keto.replication.leader")
 
@@ -212,7 +217,25 @@ class ReplicationSource:
                 return web.json_response(out)
             await asyncio.sleep(self.poll_interval_s)
 
+    async def handle_digest(self, request: web.Request) -> web.Response:
+        q = request.rel_url.query
+        try:
+            chunk_size = int(q.get("chunk_size", 1024))
+        except ValueError:
+            return web.json_response(
+                {"error": "malformed chunk_size"}, status=400
+            )
+        if chunk_size < 1:
+            return web.json_response(
+                {"error": "chunk_size must be >= 1"}, status=400
+            )
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, compute_digest, self.store, chunk_size
+        )
+        return web.json_response(out)
+
     def register(self, app: web.Application) -> None:
         app.router.add_get("/replication/status", self.handle_status)
         app.router.add_get("/replication/checkpoint", self.handle_checkpoint)
         app.router.add_get("/replication/wal", self.handle_wal)
+        app.router.add_get("/replication/digest", self.handle_digest)

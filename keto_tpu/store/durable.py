@@ -200,6 +200,10 @@ class DurableTupleStore:
         #: registry wires to the snapshot layer so checkpoints can embed
         #: the derived CSR
         self.csr_provider = None
+        #: optional ``(errno_or_none: int | None) -> None`` hook the
+        #: registry wires to ``keto_wal_append_errors_total{errno}``; called
+        #: once per failed append, BEFORE the failure propagates
+        self.append_error_cb = None
 
         self._pid = os.getpid()
         self._mutate_lock = threading.Lock()
@@ -288,6 +292,12 @@ class DurableTupleStore:
                     self.wal.append(version, inserted, deleted)
         except BaseException as e:
             self._broken = e
+            cb = self.append_error_cb
+            if cb is not None:
+                try:
+                    cb(getattr(e, "errno", None))
+                except Exception:
+                    pass  # counting the failure must not mask it
             raise
 
     # -- mutators (the durable surface) ----------------------------------------

@@ -49,6 +49,7 @@ Fault sites compiled into the append path (see keto_tpu/faults.py):
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import struct
@@ -244,6 +245,55 @@ def _scan_segment(path: str, final: bool, stats: ReplayStats):
     return records, off
 
 
+def sealed_segments(directory: str) -> list[tuple[int, str]]:
+    """Segments that will never be appended to again (everything but the
+    active tail). These are the scrubber's bitrot-scan population: the tail
+    is still being written, so 'damage' there is indistinguishable from an
+    in-flight append."""
+    return _list_segments(directory)[:-1]
+
+
+def verify_segment(path: str) -> dict:
+    """Integrity-only rescan of one sealed segment: walk every frame and
+    recheck CRCs without materialising tuples for the caller. ``final=False``
+    because a sealed segment has no legitimate torn tail — any damage is
+    bitrot over acked records."""
+    stats = ReplayStats()
+    records, _end = _scan_segment(path, final=False, stats=stats)
+    return {
+        "path": path,
+        "ok": not (stats.gap or stats.bad_frames),
+        "records": len(records),
+        "bad_frames": stats.bad_frames,
+        "gap": stats.gap,
+        "notes": list(stats.notes),
+    }
+
+
+def inject_bitrot(directory: str) -> Optional[str]:
+    """Fault-site helper for ``wal.bitrot``: flip one byte inside the frame
+    region of a sealed segment, in place. Returns the damaged path, or None
+    when there is no sealed segment to damage (the drill should retry after
+    a rotation)."""
+    sealed = sealed_segments(directory)
+    if not sealed:
+        return None
+    _first, path = sealed[0]
+    size = os.path.getsize(path)
+    # aim past the magic and the first frame header, into payload bytes
+    off = len(_FILE_MAGIC) + _FRAME.size
+    if size <= off:
+        return None
+    with open(path, "r+b") as f:
+        f.seek(off)
+        cur = f.read(1)
+        f.seek(off)
+        f.write(bytes([cur[0] ^ 0xFF]))
+        f.flush()
+        os.fsync(f.fileno())
+    return path
+
+
 class WriteAheadLog:
     """Append-side handle. Thread-safe; one instance owns the directory's
     active tail segment. Opening truncates any torn tail left by a crash
@@ -318,6 +368,10 @@ class WriteAheadLog:
         self.synced_records = self.appended_records
 
     def _write_frame(self, payload: bytes, version: int) -> None:
+        if FAULTS.should_fire("wal.enospc"):
+            # disk full before a single byte lands: the append raises, the
+            # store never acks, and the durable wrapper fail-stops
+            raise OSError(errno.ENOSPC, "No space left on device")
         self._rotate_if_needed(version)
         crc = zlib.crc32(payload)
         frame = _FRAME.pack(crc, len(payload)) + payload

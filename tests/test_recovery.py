@@ -241,6 +241,64 @@ class TestCheckpoint:
         assert ckpt.version == v1
         assert ckpt.meta.get("skipped_damaged")
 
+    @staticmethod
+    def _tamper_payload(path):
+        """Rewrite the npz with one payload value changed but the OLD
+        meta blob kept: the zip container stays structurally valid, so
+        only the sha256 verification can catch the damage (a truncation
+        test would pass on zip CRCs alone)."""
+        import numpy as np
+
+        with np.load(path, allow_pickle=False) as npz:
+            arrays = {n: npz[n] for n in npz.files}
+        for name, arr in sorted(arrays.items()):
+            if name != "meta" and arr.dtype.kind in "iu" and arr.size:
+                arr = arr.copy()
+                arr.flat[0] ^= 1
+                arrays[name] = arr
+                break
+        else:
+            raise AssertionError("no integer payload array to tamper")
+        np.savez(path.removesuffix(".npz"), **arrays)
+
+    def test_sha256_catches_silent_payload_corruption(self, tmp_path, kind):
+        store = self._build(kind)
+        ckpt_mod.write_checkpoint(str(tmp_path), store, keep=5)
+        v1 = store.version
+        store.write_relation_tuples(_t(52))
+        newest = ckpt_mod.write_checkpoint(str(tmp_path), store, keep=5)
+        self._tamper_payload(newest)
+
+        # the damaged checkpoint must never load silently
+        with pytest.raises(ckpt_mod.CheckpointError, match="sha256"):
+            ckpt_mod.load_checkpoint(newest)
+        # recovery falls back to the older, intact checkpoint
+        ckpt = ckpt_mod.load_latest(str(tmp_path))
+        assert ckpt.version == v1
+        assert ckpt.meta.get("skipped_damaged")
+
+    def test_pre_sha256_checkpoints_still_load(self, tmp_path, kind):
+        """Checkpoints written before the sha256 field existed (or by an
+        older binary) must load unverified rather than fail."""
+        import json as _json
+
+        import numpy as np
+
+        store = self._build(kind)
+        path = ckpt_mod.write_checkpoint(str(tmp_path), store)
+        with np.load(path, allow_pickle=False) as npz:
+            arrays = {n: npz[n] for n in npz.files}
+        meta = _json.loads(bytes(arrays["meta"]).decode("utf-8"))
+        meta.pop("sha256")
+        arrays["meta"] = np.frombuffer(
+            _json.dumps(meta).encode("utf-8"), dtype=np.uint8
+        )
+        np.savez(path.removesuffix(".npz"), **arrays)
+
+        fresh = STORE_KINDS[kind]()
+        ckpt_mod.load_checkpoint(path).restore_into(fresh)
+        assert _tuples_of(fresh) == _tuples_of(store)
+
 
 # -- durable wrapper + recovery ----------------------------------------------
 

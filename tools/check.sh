@@ -61,7 +61,12 @@
 #      surface: converge to the interior optimum, ride the monotone
 #      knob to its bound, exercise the revert path, never apply a
 #      value outside the declared bounds, freeze/thaw on a guard flip
-#  10. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
+#  10. scrub gate — tools/scrub_gate.py: every integrity fault site
+#      (scrub.device_bitflip, wal.bitrot, replica.skip_delta) injected
+#      against a real engine/WAL/follower, detected within the cycle
+#      budget, auto-repaired, and the post-repair state byte-identical
+#      to the host truth (oracle answers / cold recovery / leader set)
+#  11. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
 #
 # Usage: bash tools/check.sh            (from the repo root)
 set -o pipefail
@@ -119,6 +124,13 @@ echo "== autotune gate =="
 # the online autotuner's controller logic, seeded + deterministic: must
 # converge, never leave the knob bounds, and exercise a revert
 timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/autotune_gate.py || exit 1
+
+echo "== scrub gate =="
+# the integrity plane end to end: inject each fault site, require
+# detection within the cycle budget, automatic repair, and byte-identical
+# post-repair state (engine vs oracle, cold recovery vs live store,
+# follower vs leader)
+timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/scrub_gate.py || exit 1
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -211,6 +211,9 @@ def _run_live_gate() -> list[str]:
             # keto_autotune_* families for the lint, not actually move
             # knobs mid-scrape)
             "autotune": {"enabled": True, "interval_s": 600.0},
+            # scrubber on (same long-interval trick: registers the
+            # keto_scrub_* families without scrubbing mid-scrape)
+            "scrub": {"enabled": True, "interval_s": 600.0},
         },
         env={},
     )
