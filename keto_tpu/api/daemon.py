@@ -188,6 +188,7 @@ def build_read_grpc_server(
     version_waiter=None,  # follower replication gate (replication/follower.py)
     encoded_front=None,  # id-native wire tier (api/encoded.py), or None
     list_engine=None,  # reverse-index list serving (engine/listing.py), or None
+    default_criticality: str = "default",  # overload.default_criticality
 ) -> grpc.Server:
     """Read-plane gRPC: Check + Expand + Read + Version + Health +
     reflection (plus List when the reverse-index tier is on), behind the
@@ -208,6 +209,7 @@ def build_read_grpc_server(
             checker, snaptoken_fn, max_freshness_wait_s=max_freshness_wait_s,
             telemetry=telemetry, version_waiter=version_waiter,
             encoded_front=encoded_front,
+            default_criticality=default_criticality,
         ),
     )
     add_expand_service(

@@ -130,6 +130,7 @@ class DebugContext:
         instance_id: str = "",
         autotune_fn=None,
         scrub_fn=None,
+        overload_fn=None,
     ):
         self.config = config
         self.flight = flight
@@ -165,6 +166,9 @@ class DebugContext:
         # integrity plane: same getter discipline for the ScrubDaemon
         # (None until scrub.enabled builds one)
         self.scrub_fn = scrub_fn
+        # overload-control plane: getter for the OverloadController
+        # (None until overload.enabled builds one)
+        self.overload_fn = overload_fn
 
 
 class DebugAPI:
@@ -182,6 +186,7 @@ class DebugAPI:
         app.router.add_get("/debug/attribution", self.get_attribution)
         app.router.add_get("/debug/autotune", self.get_autotune)
         app.router.add_get("/debug/scrub", self.get_scrub)
+        app.router.add_get("/debug/overload", self.get_overload)
         app.router.add_get("/debug/pprof", self.get_pprof)
         app.router.add_get("/debug/device", self.get_device)
         app.router.add_get("/debug/cluster", self.get_cluster)
@@ -451,9 +456,26 @@ class DebugAPI:
             if self.ctx.autotune_fn is not None
             else None
         )
+        # brownout rung 1 (engine/overload.py): under pressure the server
+        # stops recommending its tuned (aggressive) hedge delay — clients
+        # that poll this page fall back to their own conservative estimate
+        # instead of duplicating load onto an overloaded fleet. Reported
+        # even with the tuner off: suppression is the overload plane's
+        # signal, not the tuner's
+        ov = (
+            self.ctx.overload_fn()
+            if self.ctx.overload_fn is not None
+            else None
+        )
+        suppressed = ov is not None and ov.hedge_suppressed()
         if tuner is None:
             return web.json_response(
-                {"enabled": False, "running": False, "knobs": {}},
+                {
+                    "enabled": False,
+                    "running": False,
+                    "knobs": {},
+                    "hedge_suppressed": suppressed,
+                },
                 dumps=_dumps,
             )
         try:
@@ -462,6 +484,33 @@ class DebugAPI:
             n = 50
         payload = tuner.snapshot()
         payload["history"] = tuner.history(n)
+        payload["hedge_suppressed"] = suppressed
+        if suppressed:
+            knob = payload.get("knobs", {}).get("hedge_delay_ms")
+            if isinstance(knob, dict):
+                knob["value"] = None
+        return web.json_response(payload, dumps=_dumps)
+
+    async def get_overload(self, request: web.Request) -> web.Response:
+        """The overload-control plane's state: brownout ladder rung,
+        adaptive admission limit vs the static max_queue backstop,
+        throttle accept/request window, sheds by criticality class, and
+        the newest-first transition history (``?n=`` caps it, default
+        50) — the page to pull when keto_overload_state moves."""
+        self._gate(request)
+        ctl = (
+            self.ctx.overload_fn()
+            if self.ctx.overload_fn is not None
+            else None
+        )
+        if ctl is None:
+            return web.json_response({"enabled": False}, dumps=_dumps)
+        try:
+            n = int(request.rel_url.query.get("n", 50))
+        except ValueError:
+            n = 50
+        payload = ctl.snapshot()
+        payload["history"] = ctl.history(n)
         return web.json_response(payload, dumps=_dumps)
 
     async def get_scrub(self, request: web.Request) -> web.Response:

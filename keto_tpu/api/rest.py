@@ -38,12 +38,14 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import time
 from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Optional
 
 from aiohttp import web
 
+from ..engine.overload import parse_criticality
 from ..relationtuple.columns import CheckColumns
 from ..telemetry.flight import NOOP_CHECK_TELEMETRY
 from ..telemetry.tracing import HEDGE_HEADER, TRACEPARENT_HEADER
@@ -76,6 +78,19 @@ ROUTE_LIST_SUBJECTS = "/relation-tuples/list-subjects"
 #: the REST spelling of a gRPC deadline: milliseconds of budget the caller
 #: grants this request, measured from when the header is parsed
 DEADLINE_HEADER = "X-Request-Deadline-Ms"
+
+#: criticality class for the overload brownout ladder: ``critical`` |
+#: ``default`` | ``sheddable``. Unknown values fall back to ``default``
+#: (a typo must not change the answer, only the shed priority)
+CRITICALITY_HEADER = "X-Request-Criticality"
+
+
+def criticality_from_headers(
+    request: web.Request, default: str = "default"
+) -> str:
+    return parse_criticality(
+        request.headers.get(CRITICALITY_HEADER), default=default
+    )
 
 
 def deadline_from_headers(request: web.Request) -> Optional[float]:
@@ -111,8 +126,10 @@ def _json_error(err: KetoError) -> web.Response:
     retry_after = getattr(err, "retry_after_s", None)
     if retry_after is not None or err.status_code in (429, 503):
         # load shed / transient unavailability: invite the retry-with-
-        # backoff the client SDK implements
-        headers["Retry-After"] = str(int(retry_after or 1))
+        # backoff the client SDK implements. Round UP and never emit 0:
+        # a sub-second hint truncated to "Retry-After: 0" invites the
+        # immediate re-arrival the header exists to prevent
+        headers["Retry-After"] = str(max(1, math.ceil(retry_after or 1)))
     return web.json_response(
         err.envelope(), status=err.status_code, headers=headers
     )
@@ -305,6 +322,7 @@ class ReadAPI:
         self, manager, checker, expand_engine, snaptoken_fn, executor=None,
         telemetry=None, version_waiter=None, max_freshness_wait_s=30.0,
         encoded_front=None, list_engine=None,
+        default_criticality: str = "default",
     ):
         self.manager = manager
         # reverse-index list serving (engine/listing.ListEngine); None when
@@ -326,6 +344,9 @@ class ReadAPI:
         # sized by the registry so in-flight checks can fill a device batch
         # (the loop's default executor caps at ~32 threads)
         self.executor = executor
+        # criticality assigned to requests carrying no
+        # X-Request-Criticality header (overload.default_criticality)
+        self.default_criticality = default_criticality
         # per-request check telemetry (span + histogram exemplar + SLO +
         # flight recorder); entered INSIDE the executor work function
         # because run_in_executor does not propagate contextvars — a span
@@ -430,6 +451,9 @@ class ReadAPI:
         max_depth = max_depth_from_query(p)
         min_version = _min_version_from_query(p)
         deadline = deadline_from_headers(request)
+        criticality = criticality_from_headers(
+            request, self.default_criticality
+        )
         if deadline is not None and time.monotonic() >= deadline:
             raise DeadlineExceeded()
         traceparent, hedge = _trace_from_headers(request)
@@ -487,7 +511,7 @@ class ReadAPI:
                 self._await_freshness(min_version, deadline)
                 allowed = self.checker.check_batch(
                     tuples, max_depth, min_version=min_version,
-                    deadline=deadline,
+                    deadline=deadline, criticality=criticality,
                 )
                 text = json.dumps(
                     {"allowed": allowed, "snaptoken": self.snaptoken_fn()}
@@ -598,6 +622,9 @@ class ReadAPI:
         min_version: int = 0,
     ) -> web.Response:
         deadline = deadline_from_headers(request)
+        criticality = criticality_from_headers(
+            request, self.default_criticality
+        )
         traceparent, hedge = _trace_from_headers(request)
         # entry_hook hands back the batcher future so a client disconnect
         # (this coroutine cancelled) can cancel it — the next pipeline
@@ -619,6 +646,7 @@ class ReadAPI:
                     min_version=min_version,
                     deadline=deadline,
                     entry_hook=entries.append,
+                    criticality=criticality,
                 )
                 text = json.dumps({"allowed": allowed})
                 rec.mark("serialize")
@@ -919,6 +947,7 @@ def build_read_app(
     logger=None, metrics=None, telemetry=None, debug=None,
     version_waiter=None, max_freshness_wait_s=30.0,
     cluster_status_fn=None, encoded_front=None, list_engine=None,
+    default_criticality: str = "default",
 ) -> web.Application:
     # telemetry outermost (sees final codes), then CORS so error
     # responses also carry the headers
@@ -934,6 +963,7 @@ def build_read_app(
         telemetry=telemetry, version_waiter=version_waiter,
         max_freshness_wait_s=max_freshness_wait_s,
         encoded_front=encoded_front, list_engine=list_engine,
+        default_criticality=default_criticality,
     ).register(app)
     register_common(app, version, healthy_fn, metrics)
     if cluster_status_fn is not None:

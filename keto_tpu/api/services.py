@@ -18,12 +18,14 @@ was computed at.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from typing import Callable, Iterator, Optional
 
 import grpc
 
+from ..engine.overload import parse_criticality
 from ..faults import FAULTS
 from ..relationtuple.columns import CheckColumns, proto_has_columns
 from ..telemetry.flight import NOOP_CHECK_TELEMETRY
@@ -62,6 +64,21 @@ from .convert import (
 )
 
 _PKG = "ory.keto.acl.v1alpha1"
+
+#: gRPC spelling of the REST X-Request-Criticality header: the overload
+#: brownout ladder's shed class (critical | default | sheddable)
+CRITICALITY_METADATA_KEY = "x-keto-criticality"
+
+
+def _criticality_from_metadata(context, default: str = "default") -> str:
+    try:
+        metadata = context.invocation_metadata() or ()
+    except Exception:
+        return parse_criticality(None, default=default)
+    for key, value in metadata:
+        if key == CRITICALITY_METADATA_KEY:
+            return parse_criticality(value, default=default)
+    return parse_criticality(None, default=default)
 
 
 def _trace_from_metadata(context) -> tuple:
@@ -103,8 +120,9 @@ def _abort(context: grpc.ServicerContext, err: Exception):
         retry_after = getattr(err, "retry_after_s", None)
         if retry_after is not None:
             # the gRPC spelling of Retry-After: a trailing-metadata hint
-            # for shed requests (RESOURCE_EXHAUSTED)
-            trailing.append(("retry-after", str(int(retry_after))))
+            # for shed requests (RESOURCE_EXHAUSTED). Round UP, never 0 —
+            # a truncated sub-second hint invites immediate re-arrival
+            trailing.append(("retry-after", str(max(1, math.ceil(retry_after)))))
         details = err.envelope().get("error", {}).get("details")
         if details is not None:
             # structured error details (e.g. the vocab-epoch resync hint)
@@ -133,6 +151,7 @@ class CheckServicer:
         telemetry=None,
         version_waiter=None,
         encoded_front=None,
+        default_criticality: str = "default",
     ):
         self.checker = checker
         self.snaptoken_fn = snaptoken_fn
@@ -143,6 +162,9 @@ class CheckServicer:
         # follower-only: wait_for_version(min_version, timeout_s) blocking
         # until replication replays past the token (replication/follower.py)
         self.version_waiter = version_waiter
+        # criticality assigned to calls carrying no x-keto-criticality
+        # metadata (overload.default_criticality)
+        self.default_criticality = default_criticality
         # per-request check telemetry (span + histogram exemplar + SLO +
         # flight recorder); entered on the handler thread so the span
         # contextvar is visible inside checker.check()
@@ -207,6 +229,9 @@ class CheckServicer:
                 lambda: [f.cancel() for f in entries]
             )
             traceparent, hedge = _trace_from_metadata(context)
+            criticality = _criticality_from_metadata(
+                context, self.default_criticality
+            )
             # response built INSIDE the record so proto construction is
             # charged to the ledger's 'serialize' stage (and 'reply'
             # covers only the record-exit bookkeeping)
@@ -222,6 +247,7 @@ class CheckServicer:
                     min_version=min_version,
                     deadline=deadline,
                     entry_hook=entries.append,
+                    criticality=criticality,
                 )
                 resp = check_service_pb2.CheckResponse(
                     allowed=allowed, snaptoken=self.snaptoken_fn()
@@ -299,6 +325,9 @@ class CheckServicer:
                     min_version=min_version,
                     timeout=timeout,
                     deadline=deadline,
+                    criticality=_criticality_from_metadata(
+                        context, self.default_criticality
+                    ),
                 )
                 resp = check_service_pb2.BatchCheckResponse(
                     allowed=allowed, snaptoken=self.snaptoken_fn()
@@ -967,10 +996,12 @@ class _DirectChecker:
         min_version: int = 0,
         deadline: Optional[float] = None,
         entry_hook=None,
+        criticality: str = "default",
     ) -> bool:
         # the direct engines answer from live data (host oracle) or
-        # rebuild synchronously, so any min_version is already satisfied
-        del timeout, min_version, entry_hook
+        # rebuild synchronously, so any min_version is already satisfied;
+        # direct dispatch has no queue, so criticality has nothing to shed
+        del timeout, min_version, entry_hook, criticality
         if deadline is not None and time.monotonic() >= deadline:
             raise DeadlineExceeded()
         return self.engine.subject_is_allowed(request, max_depth)
@@ -982,10 +1013,12 @@ class _DirectChecker:
         min_version: int = 0,
         timeout: Optional[float] = None,
         deadline: Optional[float] = None,
+        criticality: str = "default",
     ) -> list:
         from ..engine.batcher import dispatch_batched
 
-        del min_version, timeout  # direct engines answer from live data
+        # direct engines answer from live data; no queue, nothing to shed
+        del min_version, timeout, criticality
         if deadline is not None and time.monotonic() >= deadline:
             raise DeadlineExceeded()
         return dispatch_batched(

@@ -25,7 +25,10 @@ Semantics, in the order they matter:
   not to double the error rate. Both failing raises the primary's error.
 - Counters (telemetry.metrics.hedge_counters): ``fired`` = a hedge was
   issued, ``won`` = the hedge answered first, ``wasted`` = the primary
-  answered first so the hedge's work was thrown away.
+  answered first so the hedge's work was thrown away, ``suppressed`` =
+  the primary was shed (429/RESOURCE_EXHAUSTED) so no hedge was issued —
+  duplicating a shed request doubles load exactly when the server asked
+  for less.
 
 With a replicated read plane the hedge target stops being "a second
 connection to the same port" and becomes "a DIFFERENT follower":
@@ -118,6 +121,22 @@ class HedgePolicy:
         )
 
 
+def is_overload_error(err: Optional[BaseException]) -> bool:
+    """Structural test for a server load shed on any transport: HTTP 429
+    (``status_code`` attribute, as client errors and KetoError carry) or
+    gRPC RESOURCE_EXHAUSTED (a typed error's ``grpc_code`` string, or a
+    live ``grpc.RpcError``'s ``code()``)."""
+    if err is None:
+        return False
+    if getattr(err, "status_code", None) == 429:
+        return True
+    if getattr(err, "grpc_code", None) == "RESOURCE_EXHAUSTED":
+        return True
+    from .retry import grpc_code_name
+
+    return grpc_code_name(err) == "RESOURCE_EXHAUSTED"
+
+
 class HedgedCall:
     """Outcome of one hedged request: the answer plus what the hedge did."""
 
@@ -132,9 +151,10 @@ class HedgedCall:
 
 class Hedger:
     """Runs zero-arg callables with hedging. ``counters`` is the (fired,
-    won, wasted) triple from telemetry.metrics.hedge_counters (or None).
-    Owns a small executor unless one is injected; the two attempts of one
-    request need two concurrent slots, so size accordingly."""
+    won, wasted, suppressed) tuple from telemetry.metrics.hedge_counters
+    (or None; legacy triples still count the first three). Owns a small
+    executor unless one is injected; the two attempts of one request
+    need two concurrent slots, so size accordingly."""
 
     def __init__(
         self,
@@ -163,7 +183,9 @@ class Hedger:
         self.close()
 
     def _inc(self, which: int) -> None:
-        if self._counters is not None:
+        # tolerate legacy (fired, won, wasted) triples: the suppressed
+        # counter (index 3) is simply not counted there
+        if self._counters is not None and which < len(self._counters):
             self._counters[which].inc()
 
     def call(
@@ -173,7 +195,13 @@ class Hedger:
     ) -> HedgedCall:
         """Run ``primary()``; if no answer within the policy's hedge delay,
         also run ``hedge()`` (defaults to ``primary`` — the reissue-to-pool
-        case) and return whichever answers first. At most one hedge."""
+        case) and return whichever answers first. At most one hedge.
+
+        Overload suppression: when the primary already failed with a load
+        shed (429 / RESOURCE_EXHAUSTED), NO hedge is issued — the server
+        explicitly asked for less load, and a duplicate re-arrives as
+        exactly the traffic that got the primary shed. The shed error is
+        raised as-is (counted in keto_hedge_suppressed_overload_total)."""
         start = self._clock()
         f_primary = self._executor.submit(primary)
         delay = self.policy.current_delay_s()
@@ -181,7 +209,17 @@ class Hedger:
         if done:
             elapsed = self._clock() - start
             self.policy.observe(elapsed)
+            exc = f_primary.exception()
+            if exc is not None and is_overload_error(exc):
+                self._inc(3)  # suppressed: never hedge a shed request
+                raise exc
             return HedgedCall(f_primary.result(), False, False, elapsed)
+        # the wait timed out, but the primary may have JUST failed with a
+        # shed — re-check before paying for a duplicate (closes the race
+        # between the shed landing and the hedge firing)
+        if f_primary.done() and is_overload_error(f_primary.exception()):
+            self._inc(3)  # suppressed
+            raise f_primary.exception()
         self._inc(0)  # fired
         f_hedge = self._executor.submit(hedge or primary)
         pair = {f_primary, f_hedge}

@@ -558,6 +558,60 @@ CONFIG_SCHEMA = {
             },
             "additionalProperties": False,
         },
+        # overload-control plane (engine/overload.py): adaptive admission
+        # (AIMD concurrency limit + CoDel standing-queue target at batcher
+        # admission), the criticality brownout ladder, and the SRE-style
+        # accepts/requests server throttle. The kill switch (enabled) is
+        # hot-reloadable: the controller re-reads it on every decision, so
+        # flipping it off in the config file makes the plane admit-all at
+        # the next request without a restart
+        "overload": {
+            "type": "object",
+            "properties": {
+                "enabled": {"type": "boolean"},
+                # CoDel standing-queue delay target: queue delay above
+                # this sustained for interval_ms flips FIFO->LIFO and
+                # culls entries older than the target
+                "target_delay_ms": {"type": "number", "exclusiveMinimum": 0},
+                # AIMD adjustment cadence + the CoDel sustain window
+                "interval_ms": {"type": "number", "exclusiveMinimum": 0},
+                # the adaptive limit never decreases below this
+                "min_limit": {"type": "integer", "minimum": 1},
+                # latency inflation (recent EWMA over healthy baseline)
+                # beyond this multiple triggers multiplicative decrease
+                "tolerance": {"type": "number", "minimum": 1},
+                # multiplicative-decrease factor and additive-increase
+                # step of the AIMD limit
+                "decrease": {
+                    "type": "number", "exclusiveMinimum": 0, "maximum": 1,
+                },
+                "additive": {"type": "number", "exclusiveMinimum": 0},
+                # the brownout ladder steps DOWN one rung only after
+                # pressure stays below the rung for this long (no flap)
+                "hysteresis_ms": {"type": "number", "exclusiveMinimum": 0},
+                # minimum time between ladder step-UPS (one rung at a
+                # time, every rung observable)
+                "dwell_ms": {"type": "number", "minimum": 0},
+                # sliding window + K of the server adaptive throttle
+                # (reject probability max(0, (reqs - K*accepts)/(reqs+1)))
+                "throttle_window_s": {
+                    "type": "number", "exclusiveMinimum": 0,
+                },
+                "throttle_k": {"type": "number", "minimum": 1},
+                # /debug/overload history ring entries retained
+                "history": {"type": "integer", "minimum": 1},
+                # criticality assigned to requests that carry no
+                # X-Request-Criticality header / x-keto-criticality
+                # metadata (critical is deliberately not assignable as
+                # a blanket default: unlabeled traffic must stay
+                # sheddable before labeled-critical traffic)
+                "default_criticality": {
+                    "type": "string",
+                    "enum": ["default", "sheddable"],
+                },
+            },
+            "additionalProperties": False,
+        },
         # /debug surface on the read plane (api/debug.py)
         "debug": {
             "type": "object",
@@ -757,6 +811,19 @@ DEFAULTS = {
     "scrub.digest_chunk_size": 1024,
     "scrub.freeze_burn_rate": 0.0,
     "scrub.history": 256,
+    "overload.enabled": False,
+    "overload.target_delay_ms": 100.0,
+    "overload.interval_ms": 100.0,
+    "overload.min_limit": 8,
+    "overload.tolerance": 2.0,
+    "overload.decrease": 0.9,
+    "overload.additive": 1.0,
+    "overload.hysteresis_ms": 1000.0,
+    "overload.dwell_ms": 50.0,
+    "overload.throttle_window_s": 30.0,
+    "overload.throttle_k": 2.0,
+    "overload.history": 256,
+    "overload.default_criticality": "default",
     "debug.enabled": True,
     "debug.token": "",
     "debug.profile_max_s": 30,

@@ -391,6 +391,10 @@ class Registry:
         # integrity scrubber (engine/scrub.py): built lazily by
         # scrubber(), daemon started in start_all after any replica fork
         self._scrubber = None
+        # overload-control plane (engine/overload.py): built lazily by
+        # overload() — event-driven (no daemon), decisions happen inline
+        # at the batcher's admission seam
+        self._overload = None
         # the reply-stage virtual knob: the hedge delay this server
         # currently advertises to clients (surfaced via /debug/autotune;
         # clients adopt it with HedgePolicy.advertise). Starts at the
@@ -695,6 +699,7 @@ class Registry:
                 # /debug/autotune must never construct it as a side effect
                 autotune_fn=lambda: self._autotuner,
                 scrub_fn=lambda: self._scrubber,
+                overload_fn=lambda: self._overload,
                 cluster=self.federation(),
                 instance_id=(
                     self.cluster_instance_id()
@@ -1208,6 +1213,7 @@ class Registry:
                     tracer=self.tracer(),
                     qos=self.qos(),
                     hbm=self.hbm_admission(),
+                    overload=self.overload(),
                 )
                 self._checker = self._batcher
         return self._checker
@@ -2091,6 +2097,87 @@ class Registry:
             )
         return self._qos
 
+    def overload(self):
+        """The overload-control plane (engine/overload.py): AIMD adaptive
+        admission + CoDel queue discipline, the criticality brownout
+        ladder, and the accepts/requests server throttle — handed to the
+        CheckBatcher's admission seam. None unless overload.enabled; the
+        enabled_fn re-reads the config per decision, so flipping
+        overload.enabled off in a reloaded file is a live kill switch
+        (the controller stays built but admits everything)."""
+        if self._overload is None and bool(
+            self.config.get("overload.enabled", default=False)
+        ):
+            from ..engine.overload import (
+                AdaptiveLimiter,
+                AdaptiveThrottle,
+                BrownoutController,
+                OverloadController,
+            )
+
+            cfg = self.config
+            max_queue = int(cfg.get("engine.max_queue", default=0))
+            if max_queue <= 0:
+                # the batcher's own backstop default (engine/batcher.py)
+                max_queue = 8 * int(cfg.get("engine.max_batch"))
+            target_s = (
+                float(cfg.get("overload.target_delay_ms", default=100.0))
+                / 1e3
+            )
+            interval_s = (
+                float(cfg.get("overload.interval_ms", default=100.0)) / 1e3
+            )
+            limiter = AdaptiveLimiter(
+                initial=max_queue,
+                min_limit=int(cfg.get("overload.min_limit", default=8)),
+                max_limit=max_queue,
+                additive=float(cfg.get("overload.additive", default=1.0)),
+                decrease=float(cfg.get("overload.decrease", default=0.9)),
+                target_delay_s=target_s,
+                interval_s=interval_s,
+                tolerance=float(cfg.get("overload.tolerance", default=2.0)),
+            )
+            brownout = BrownoutController(
+                hysteresis_s=(
+                    float(cfg.get("overload.hysteresis_ms", default=1000.0))
+                    / 1e3
+                ),
+                min_dwell_s=(
+                    float(cfg.get("overload.dwell_ms", default=50.0)) / 1e3
+                ),
+                flight=self.flight(),
+                logger=self.logger(),
+                history=int(cfg.get("overload.history", default=256)),
+            )
+            throttle = AdaptiveThrottle(
+                window_s=float(
+                    cfg.get("overload.throttle_window_s", default=30.0)
+                ),
+                k=float(cfg.get("overload.throttle_k", default=2.0)),
+            )
+            self._overload = OverloadController(
+                max_queue=max_queue,
+                limiter=limiter,
+                brownout=brownout,
+                throttle=throttle,
+                metrics=self.metrics(),
+                flight=self.flight(),
+                logger=self.logger(),
+                enabled_fn=lambda: bool(
+                    self.config.get("overload.enabled", default=False)
+                ),
+            )
+        return self._overload
+
+    def default_criticality(self) -> str:
+        """Criticality class assigned to requests that carry no explicit
+        header/metadata (overload.default_criticality)."""
+        return str(
+            self.config.get(
+                "overload.default_criticality", default="default"
+            )
+        )
+
     def snaptoken(self) -> str:
         """Write-plane snaptoken: the store's durable position — a
         structured zookie (z<version>.<segment>.<offset>) on WAL-backed
@@ -2187,6 +2274,7 @@ class Registry:
                 version_waiter=self.version_waiter(),
                 encoded_front=self.encoded_front(),
                 list_engine=self.list_engine(),
+                default_criticality=self.default_criticality(),
             )
             app = build_read_app(
                 self.store(),
@@ -2206,6 +2294,7 @@ class Registry:
                 cluster_status_fn=self._cluster_status_fn(),
                 encoded_front=self.encoded_front(),
                 list_engine=self.list_engine(),
+                default_criticality=self.default_criticality(),
             )
             self._read_plane = PlaneServer(
                 grpc_server,
@@ -2882,6 +2971,8 @@ class Registry:
             await self._write_plane.stop()
         if self._batcher is not None:
             self._batcher.close()
+        # no daemon to stop: the overload controller is event-driven
+        self._overload = None
         if self._device_supervisor is not None:
             # after the batcher: no new launches can hit a half-recovered
             # backend once the dispatch loops are drained

@@ -147,11 +147,13 @@ class CheckBatcher:
         tracer=None,  # stage spans join the caller's trace when set
         qos=None,  # NamespaceQos: per-tenant token-bucket admission
         hbm=None,  # HbmAdmission: device-memory budget; None disables
+        overload=None,  # OverloadController: adaptive admission + brownout
     ):
         self.engine = engine
         self.tracer = tracer
         self.qos = qos
         self.hbm = hbm
+        self.overload = overload
         self.max_batch = max_batch
         self.window_s = window_s
         self.cache = cache
@@ -339,6 +341,7 @@ class CheckBatcher:
         deadline: Optional[float] = None,  # absolute time.monotonic() secs
         entry_hook=None,  # called with the entry Future after enqueue —
         # transports hold it to cancel on client disconnect
+        criticality: str = "default",  # critical | default | sheddable
     ) -> bool:
         if self._closed:
             raise BatcherClosed()
@@ -358,17 +361,25 @@ class CheckBatcher:
         if min_version > 0:
             # at-least-as-fresh consistency (CheckRequest.snaptoken): make
             # the serving snapshot catch up before answering. The cache is
-            # still safe afterward — its stamp is the answering version
-            wait = getattr(self.engine, "wait_for_version", None)
-            if wait is not None:
-                wait(
-                    min_version,
-                    timeout_s=(
-                        timeout
-                        if timeout is not None
-                        else self.max_freshness_wait_s()
-                    ),
-                )
+            # still safe afterward — its stamp is the answering version.
+            # Brownout rung 2+ relaxes this to bounded-stale: answer at
+            # the current snapshot instead of spending queue time waiting
+            # for one — the freshness wait is the cheapest latency to
+            # refuse under pressure, after hedges
+            ov = self.overload
+            if ov is not None and ov.stale_ok():
+                ov.note_stale_served()
+            else:
+                wait = getattr(self.engine, "wait_for_version", None)
+                if wait is not None:
+                    wait(
+                        min_version,
+                        timeout_s=(
+                            timeout
+                            if timeout is not None
+                            else self.max_freshness_wait_s()
+                        ),
+                    )
             if deadline is not None and time.monotonic() >= deadline:
                 # the freshness wait consumed the whole budget
                 self._note_expired("admission", 1)
@@ -392,18 +403,31 @@ class CheckBatcher:
         with self._cv:
             if self._closed:
                 raise BatcherClosed()
+            # the adaptive overload plane is the primary shed signal:
+            # latency-driven brownout by criticality class, plus the SRE
+            # accepts/requests throttle once the ladder is shedding
+            if self.overload is not None:
+                reason = self.overload.admit(len(self._queue), criticality)
+                if reason is not None:
+                    if self._m_shed is not None:
+                        self._m_shed.inc()
+                    raise BatcherOverloaded(
+                        f"The server is overloaded ({reason}, "
+                        f"criticality={criticality}); retry with backoff."
+                    )
             if len(self._queue) >= self.max_queue:
-                # shed at admission: a full queue means the engine is
-                # already saturated max_queue/max_batch dispatches deep —
-                # queueing further only converts overload into latency
-                # for every caller
+                # hard backstop behind the adaptive limiter: a full queue
+                # means the engine is already saturated max_queue/max_batch
+                # dispatches deep — queueing further only converts overload
+                # into latency for every caller. This bound sheds even
+                # `critical` traffic; the brownout ladder never does
                 if self._m_shed is not None:
                     self._m_shed.inc()
                 raise BatcherOverloaded()
             self._queue.append(
                 (
                     request, max_depth, f, time.perf_counter(), deadline,
-                    led, span_ctx,
+                    led, span_ctx, criticality,
                 )
             )
             self._cv.notify()
@@ -430,6 +454,7 @@ class CheckBatcher:
         min_version: int = 0,
         timeout: Optional[float] = None,
         deadline: Optional[float] = None,
+        criticality: str = "default",
     ) -> list[bool]:
         """A caller-assembled batch: already amortized, so it skips the
         queue and dispatches directly (the batch-check transport path).
@@ -445,6 +470,18 @@ class CheckBatcher:
             for r in requests:
                 counts[r.namespace] = counts.get(r.namespace, 0) + 1
             self.qos.admit_counts(counts)
+        if self.overload is not None:
+            # one admission decision covers the whole caller-assembled
+            # batch — it rides the direct path, but it still competes with
+            # the queue for engine time, so it sheds by the same ladder
+            reason = self.overload.admit(len(self._queue), criticality)
+            if reason is not None:
+                if self._m_shed is not None:
+                    self._m_shed.inc()
+                raise BatcherOverloaded(
+                    f"The server is overloaded ({reason}, "
+                    f"criticality={criticality}); retry with backoff."
+                )
         if deadline is not None:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -452,16 +489,20 @@ class CheckBatcher:
                 raise DeadlineExceeded()
             timeout = remaining if timeout is None else min(timeout, remaining)
         if min_version > 0:
-            wait = getattr(self.engine, "wait_for_version", None)
-            if wait is not None:
-                wait(
-                    min_version,
-                    timeout_s=(
-                        timeout
-                        if timeout is not None
-                        else self.max_freshness_wait_s()
-                    ),
-                )
+            ov = self.overload
+            if ov is not None and ov.stale_ok():
+                ov.note_stale_served()
+            else:
+                wait = getattr(self.engine, "wait_for_version", None)
+                if wait is not None:
+                    wait(
+                        min_version,
+                        timeout_s=(
+                            timeout
+                            if timeout is not None
+                            else self.max_freshness_wait_s()
+                        ),
+                    )
             if deadline is not None and time.monotonic() >= deadline:
                 self._note_expired("admission", 1)
                 raise DeadlineExceeded()
@@ -834,6 +875,8 @@ class CheckBatcher:
             "deadline_expired": expired,
             "cancelled": cancelled,
         }
+        if self.overload is not None:
+            out["overload"] = self.overload.snapshot()
         if self.pipelined:
             with self._lock:
                 inflight = len(self._pipe_batches)
@@ -957,6 +1000,46 @@ class CheckBatcher:
     # -- shared plumbing -----------------------------------------------------
 
     def _drain(self) -> list[tuple]:
+        ov = self.overload
+        if ov is not None and self._queue:
+            cutoff = ov.cull_age_s()
+            if cutoff is not None:
+                # CoDel cull under sustained pressure: entries that have
+                # already queued past the delay target would blow their
+                # budget anyway — fail them typed now, free the slots
+                now = time.perf_counter()
+                kept: list[tuple] = []
+                culled = 0
+                for it in self._queue:
+                    # critical-class entries are exempt: the plane's
+                    # promise is that only the max_queue backstop ever
+                    # drops critical work (adaptive LIFO may still serve
+                    # it late, but it is never failed by the cull)
+                    if (
+                        now - it[3] > cutoff
+                        and not (len(it) > 7 and it[7] == "critical")
+                    ):
+                        f = it[2]
+                        if not f.done():
+                            f.set_exception(
+                                BatcherOverloaded(
+                                    "The check queued past the standing-"
+                                    "queue delay target and was culled; "
+                                    "retry with backoff."
+                                )
+                            )
+                        culled += 1
+                    else:
+                        kept.append(it)
+                if culled:
+                    self._queue[:] = kept
+                    ov.note_culled(culled)
+            if ov.lifo() and self._queue:
+                # adaptive-LIFO while overloaded: the newest entries are
+                # the ones most likely to still meet their deadlines
+                batch = self._queue[-self._admit_rows():]
+                del self._queue[-len(batch):]
+                return batch
         batch = self._queue[: self._admit_rows()]
         del self._queue[: len(batch)]
         return batch
@@ -1101,7 +1184,8 @@ class CheckBatcher:
                 self._inflight = batch
             if self._m_batch_size is not None:
                 self._m_batch_size.observe(len(batch))
-            self._mark_items(batch, "queue", time.perf_counter())
+            t_dispatch = time.perf_counter()
+            self._mark_items(batch, "queue", t_dispatch)
             requests = [b[0] for b in batch]
             depths = [b[1] for b in batch]
             span = None
@@ -1129,6 +1213,13 @@ class CheckBatcher:
                 with self._cv:
                     self._inflight = []
                 continue
+            if self.overload is not None:
+                # queue delay (oldest entry's wait) + engine service time
+                # feed the adaptive limiter's AIMD/CoDel signal
+                self.overload.observe(
+                    t_dispatch - min(it[3] for it in batch),
+                    time.perf_counter() - t_dispatch,
+                )
             # the serial engine call is monolithic (encode+kernel+decode
             # in one); charge it all to 'kernel', marked before the
             # futures resolve so callers' marks can't race
@@ -1229,6 +1320,10 @@ class CheckBatcher:
         FAULTS.maybe_sleep("batcher.encode_slow")
         t0 = time.perf_counter()
         self._observe("enqueue", t0 - min(it[3] for it in items))
+        if self.overload is not None:
+            # pipelined shape: the queue delay is the limiter signal; the
+            # per-stage service time is already attributed downstream
+            self.overload.observe(t0 - min(it[3] for it in items))
         self._mark_items(items, "queue", t0)
         if self._m_batch_size is not None:
             self._m_batch_size.observe(len(items))

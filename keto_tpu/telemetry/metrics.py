@@ -396,10 +396,15 @@ def device_failover_metrics(
     )
 
 
-def hedge_counters(registry: MetricsRegistry) -> tuple[Counter, Counter, Counter]:
-    """(fired, won, wasted) counters for hedged single-check reads: fired =
-    a hedge was issued, won = the hedge answered first, wasted = the
-    primary answered first so the hedge's work was thrown away."""
+def hedge_counters(
+    registry: MetricsRegistry,
+) -> tuple[Counter, Counter, Counter, Counter]:
+    """(fired, won, wasted, suppressed) counters for hedged single-check
+    reads: fired = a hedge was issued, won = the hedge answered first,
+    wasted = the primary answered first so the hedge's work was thrown
+    away, suppressed = the primary was shed (429/RESOURCE_EXHAUSTED) so
+    the hedge was NOT issued — duplicating a shed request doubles load
+    exactly when the server asked for less."""
     return (
         registry.counter(
             "keto_hedge_fired_total",
@@ -412,5 +417,10 @@ def hedge_counters(registry: MetricsRegistry) -> tuple[Counter, Counter, Counter
         registry.counter(
             "keto_hedge_wasted_total",
             "hedged check reads where the primary answered first",
+        ),
+        registry.counter(
+            "keto_hedge_suppressed_overload_total",
+            "hedges not issued because the primary failed with an "
+            "overload shed (429/RESOURCE_EXHAUSTED)",
         ),
     )

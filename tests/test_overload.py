@@ -1,0 +1,893 @@
+"""Overload-control plane (engine/overload.py) + the client-side retry
+discipline (client/retry.py, client/hedge.py) and the wire plumbing.
+
+Deterministic drills, fake clocks throughout: the AIMD limiter converges
+up under healthy latency and backs off multiplicatively under inflation,
+the CoDel detector flips FIFO->adaptive-LIFO and culls aged entries (but
+never critical ones), the brownout ladder escalates one observable rung
+at a time and cannot flap thanks to hysteresis, `critical` is never shed
+by the ladder, the SRE accepts/requests throttle math is exact, retry
+budgets cap client amplification, Retry-After hints floor the backoff
+and survive the REST ceil fix, hedges are suppressed when the primary
+was shed, and criticality round-trips through the REST header / gRPC
+metadata into the batcher. Plus the serving surfaces: /debug/overload,
+the hedge_suppressed flag on /debug/autotune, keto_overload_* metric
+families, and the config schema keys.
+"""
+
+import threading
+import time
+
+import httpx
+import pytest
+
+from keto_tpu.client.hedge import HedgePolicy, Hedger, is_overload_error
+from keto_tpu.client.retry import (
+    RetryBudget,
+    RetryPolicy,
+    retry_after_hint_s,
+    run_with_retry,
+)
+from keto_tpu.driver.config import CONFIG_SCHEMA, Config, DEFAULTS
+from keto_tpu.engine.overload import (
+    CRITICAL,
+    DEFAULT,
+    SHEDDABLE,
+    STATE_BOUNDED_STALE,
+    STATE_HEDGE_SUPPRESS,
+    STATE_NORMAL,
+    STATE_SHED_DEFAULT,
+    STATE_SHED_SHEDDABLE,
+    AdaptiveLimiter,
+    AdaptiveThrottle,
+    BrownoutController,
+    OverloadController,
+    parse_criticality,
+)
+from keto_tpu.relationtuple import RelationTuple, SubjectID
+from keto_tpu.telemetry import MetricsRegistry
+from keto_tpu.telemetry.flight import FlightRecorder
+from keto_tpu.utils.errors import ErrResourceExhausted
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _tup(i=0):
+    return RelationTuple("n", f"o{i}", "view", SubjectID("u"))
+
+
+# -- criticality parsing ------------------------------------------------------
+
+
+class TestParseCriticality:
+    def test_known_classes_normalized(self):
+        assert parse_criticality("critical") == CRITICAL
+        assert parse_criticality(" Sheddable ") == SHEDDABLE
+        assert parse_criticality("DEFAULT") == DEFAULT
+
+    def test_unknown_and_empty_fall_back_to_default(self):
+        # a typo'd header must not change the answer, only shed priority
+        assert parse_criticality("importantest") == DEFAULT
+        assert parse_criticality("") == DEFAULT
+        assert parse_criticality(None) == DEFAULT
+
+    def test_configured_default_class(self):
+        assert parse_criticality(None, default=SHEDDABLE) == SHEDDABLE
+        assert parse_criticality("nope", default=SHEDDABLE) == SHEDDABLE
+        # an explicit wire value still wins over the configured default
+        assert parse_criticality("critical", default=SHEDDABLE) == CRITICAL
+
+
+# -- AIMD limiter + CoDel -----------------------------------------------------
+
+
+class TestAdaptiveLimiter:
+    def test_additive_increase_under_healthy_latency(self):
+        clk = _Clock()
+        lim = AdaptiveLimiter(
+            initial=100, target_delay_s=0.1, interval_s=0.1, clock=clk
+        )
+        for _ in range(10):
+            clk.advance(0.2)
+            lim.observe(0.005, 0.005)
+        assert lim.limit == pytest.approx(100 + 10 * lim.additive)
+        assert lim.decreases == 0 and not lim.overloaded
+
+    def test_multiplicative_decrease_on_inflation(self):
+        clk = _Clock()
+        lim = AdaptiveLimiter(
+            initial=100, target_delay_s=0.1, interval_s=0.1,
+            tolerance=2.0, clock=clk,
+        )
+        for _ in range(5):  # learn a ~5ms baseline
+            clk.advance(0.2)
+            lim.observe(0.005)
+        base_limit = lim.limit
+        for _ in range(5):  # 50ms >> 2x baseline, still under CoDel target
+            clk.advance(0.2)
+            lim.observe(0.05)
+        assert lim.limit < base_limit
+        assert lim.decreases >= 1
+
+    def test_convergence_floor_is_min_limit(self):
+        clk = _Clock()
+        lim = AdaptiveLimiter(
+            initial=64, min_limit=8, target_delay_s=0.01,
+            interval_s=0.1, clock=clk,
+        )
+        for _ in range(200):
+            clk.advance(0.2)
+            lim.observe(1.0)  # hopeless overload
+        assert lim.limit == 8.0
+
+    def test_codel_sustain_flips_lifo_and_cull(self):
+        clk = _Clock()
+        lim = AdaptiveLimiter(
+            initial=100, target_delay_s=0.1, interval_s=0.1, clock=clk
+        )
+        # one above-target sample is a tolerated burst, not overload
+        lim.observe(0.2)
+        assert not lim.overloaded and lim.cull_age_s() is None
+        clk.advance(0.15)  # past interval_s with delay still above target
+        lim.observe(0.2)
+        assert lim.overloaded and lim.lifo()
+        assert lim.cull_age_s() == pytest.approx(0.1)
+        # a below-target sample ends the episode immediately
+        lim.observe(0.01)
+        assert not lim.overloaded and lim.cull_age_s() is None
+
+    def test_baseline_frozen_while_overloaded(self):
+        clk = _Clock()
+        lim = AdaptiveLimiter(
+            initial=100, target_delay_s=0.05, interval_s=0.1, clock=clk
+        )
+        lim.observe(0.005)
+        clk.advance(0.2)
+        lim.observe(0.2)
+        clk.advance(0.2)
+        lim.observe(0.2)  # sustained: overloaded
+        assert lim.overloaded
+        frozen = lim._baseline
+        clk.advance(0.2)
+        lim.observe(5.0)
+        # the storm must not teach the baseline what "good" looks like
+        assert lim._baseline == pytest.approx(frozen)
+
+
+# -- brownout ladder ----------------------------------------------------------
+
+
+class TestBrownoutLadder:
+    def _ladder(self, clk, **kw):
+        kw.setdefault("up_thresholds", (1.0, 1.5, 2.0, 3.0))
+        kw.setdefault("hysteresis_s", 1.0)
+        kw.setdefault("min_dwell_s", 0.05)
+        return BrownoutController(clock=clk, **kw)
+
+    def test_escalates_one_rung_per_dwell_never_skipping(self):
+        clk = _Clock()
+        b = self._ladder(clk)
+        seen = [b.update(99.0, clk.t)]  # pressure far past every rung
+        for _ in range(6):
+            clk.advance(0.06)
+            seen.append(b.update(99.0, clk.t))
+        # every rung visited in order: 1, 2, 3, 4, then pinned at 4
+        assert seen[:5] == [1, 2, 3, 4, 4]
+        assert b.transitions_up == 4
+
+    def test_shed_order_and_critical_exemption(self):
+        clk = _Clock()
+        b = self._ladder(clk)
+        b.state = STATE_SHED_SHEDDABLE
+        assert b.should_shed(SHEDDABLE)
+        assert not b.should_shed(DEFAULT)
+        assert not b.should_shed(CRITICAL)
+        b.state = STATE_SHED_DEFAULT
+        assert b.should_shed(SHEDDABLE) and b.should_shed(DEFAULT)
+        # the ladder's contract: critical is NEVER shed here, only by
+        # the max_queue hard backstop
+        assert not b.should_shed(CRITICAL)
+
+    def test_degradations_by_rung(self):
+        clk = _Clock()
+        b = self._ladder(clk)
+        assert not b.hedge_suppressed() and not b.stale_ok()
+        b.state = STATE_HEDGE_SUPPRESS
+        assert b.hedge_suppressed() and not b.stale_ok()
+        b.state = STATE_BOUNDED_STALE
+        assert b.hedge_suppressed() and b.stale_ok()
+
+    def test_hysteresis_prevents_flapping(self):
+        clk = _Clock()
+        b = self._ladder(clk)
+        b.update(1.2, clk.t)
+        assert b.state == 1
+        # pressure drops below down_ratio * threshold, but bounces back
+        # above it before the hysteresis window elapses: no step-down
+        for _ in range(20):
+            clk.advance(0.4)
+            b.update(0.1, clk.t)
+            clk.advance(0.4)
+            b.update(0.9, clk.t)
+        assert b.state == 1 and b.transitions_down == 0
+        # held quiet for the full window: exactly one step down
+        clk.advance(0.4)
+        b.update(0.1, clk.t)
+        clk.advance(1.1)
+        b.update(0.1, clk.t)
+        assert b.state == 0 and b.transitions_down == 1
+
+    def test_step_down_one_rung_per_quiet_window(self):
+        clk = _Clock()
+        b = self._ladder(clk, min_dwell_s=0.0)
+        for _ in range(4):
+            clk.advance(0.01)
+            b.update(99.0, clk.t)
+        assert b.state == 4
+        # a long quiet stretch steps down one rung per hysteresis window,
+        # not straight to zero (the first quiet sample only STARTS the
+        # below-threshold window)
+        states = []
+        for _ in range(6):
+            clk.advance(1.05)
+            states.append(b.update(0.0, clk.t))
+        assert states == [4, 3, 2, 1, 0, 0]
+
+    def test_idle_decay_via_current(self):
+        clk = _Clock()
+        b = self._ladder(clk)
+        b.update(1.2, clk.t)
+        assert b.state == 1
+        # zero traffic, zero updates: current() applies idle decay
+        clk.advance(5.0)
+        assert b.current(clk.t) == 0
+
+    def test_transitions_recorded_in_flight_and_history(self):
+        clk = _Clock()
+        flight = FlightRecorder(capacity=64, clock=clk)
+        b = self._ladder(clk, flight=flight)
+        b.update(1.2, clk.t)
+        clk.advance(2.0)
+        b.update(0.0, clk.t)  # starts the quiet window
+        clk.advance(1.1)
+        b.update(0.0, clk.t)  # held for a full window: steps down
+        hist = b.history()
+        assert [h["direction"] for h in hist] == ["down", "up"]
+        assert hist[1]["from"] == "normal" and hist[1]["to"] == "hedge_suppress"
+        kinds = [r.get("kind") for r in flight.records()]
+        assert kinds.count("overload") == 2
+
+    def test_threshold_validation(self):
+        with pytest.raises(ValueError):
+            BrownoutController(up_thresholds=(1.0, 1.5))
+        with pytest.raises(ValueError):
+            BrownoutController(up_thresholds=(1.0, 1.5, 1.5, 3.0))
+
+
+# -- SRE adaptive throttle ----------------------------------------------------
+
+
+class TestAdaptiveThrottle:
+    def test_zero_rejects_while_accepts_keep_up(self):
+        clk = _Clock()
+        th = AdaptiveThrottle(window_s=10.0, k=2.0, clock=clk)
+        for _ in range(100):
+            th.on_request()
+            th.on_accept()
+        assert th.reject_probability() == 0.0
+
+    def test_formula_exact(self):
+        clk = _Clock()
+        th = AdaptiveThrottle(window_s=10.0, k=2.0, clock=clk)
+        for _ in range(100):
+            th.on_request()
+        for _ in range(10):
+            th.on_accept()
+        # max(0, (reqs - K*accs) / (reqs + 1)) = (100 - 20) / 101
+        assert th.reject_probability() == pytest.approx(80 / 101)
+
+    def test_window_slides_old_buckets_out(self):
+        clk = _Clock()
+        th = AdaptiveThrottle(window_s=5.0, bucket_s=1.0, clock=clk)
+        for _ in range(50):
+            th.on_request()
+        assert th.reject_probability() > 0.9
+        clk.advance(10.0)  # everything aged out of the window
+        assert th.totals() == (0, 0)
+        assert th.reject_probability() == 0.0
+
+
+# -- client retry discipline --------------------------------------------------
+
+
+class TestRetryBudget:
+    def test_burst_then_exhaustion(self):
+        budget = RetryBudget(ratio=0.1, burst=5.0)
+        spent = sum(1 for _ in range(20) if budget.spend())
+        assert spent == 5  # the cold-start burst, then dry
+        assert budget.exhausted == 15
+
+    def test_deposits_cap_amplification_at_ratio(self):
+        budget = RetryBudget(ratio=0.1, burst=1.0)
+        retries = 0
+        for _ in range(1000):
+            budget.on_request()
+            if budget.spend():
+                retries += 1
+        # steady state: ~1 retry per 10 requests (plus the 1-token burst)
+        assert retries <= 1000 * 0.1 + 1
+
+    def test_tokens_clamped_to_burst(self):
+        budget = RetryBudget(ratio=0.5, burst=2.0)
+        for _ in range(100):
+            budget.on_request()
+        assert budget.tokens() == 2.0
+
+
+class _Shed(ErrResourceExhausted):
+    pass
+
+
+class TestRunWithRetry:
+    def test_retry_after_hint_floors_backoff(self):
+        sleeps = []
+        policy = RetryPolicy(
+            max_attempts=3, base_delay_s=0.001, jitter=0.0,
+            sleep=sleeps.append,
+        )
+        err = _Shed("shed")
+        err.retry_after_s = 0.5
+        calls = []
+
+        def attempt(_remaining):
+            calls.append(1)
+            if len(calls) < 3:
+                raise err
+            return "ok"
+
+        assert retry_after_hint_s(err) == 0.5
+        out = run_with_retry(attempt, policy, lambda e: True)
+        assert out == "ok"
+        # the server asked for >= 0.5s of quiet; nominal backoff was ~1ms
+        assert len(sleeps) == 2 and all(s >= 0.5 for s in sleeps)
+
+    def test_budget_exhaustion_stops_retrying(self):
+        policy = RetryPolicy(
+            max_attempts=10, base_delay_s=0.0, jitter=0.0, sleep=lambda s: None
+        )
+        budget = RetryBudget(ratio=0.0, burst=1.0)  # exactly one retry
+        calls = []
+
+        def attempt(_remaining):
+            calls.append(1)
+            raise _Shed("still overloaded")
+
+        with pytest.raises(_Shed):
+            run_with_retry(attempt, policy, lambda e: True, budget=budget)
+        # first attempt + the single budgeted retry; 8 permitted attempts
+        # were NOT taken — the budget refused to amplify the overload
+        assert len(calls) == 2
+
+
+class TestHedgeSuppression:
+    def test_is_overload_error_shapes(self):
+        http = _Shed("x")  # KetoError: carries status_code=429
+        assert http.status_code == 429
+        assert is_overload_error(http)
+
+        class _Typed(Exception):
+            grpc_code = "RESOURCE_EXHAUSTED"
+
+        assert is_overload_error(_Typed())
+
+        class _Code:
+            name = "RESOURCE_EXHAUSTED"
+
+        class _Rpc(Exception):
+            def code(self):
+                return _Code()
+
+        assert is_overload_error(_Rpc())
+        assert not is_overload_error(None)
+        assert not is_overload_error(ValueError("boom"))
+
+    def _counters(self):
+        class _C:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self, v=1):
+                self.n += v
+
+        return tuple(_C() for _ in range(4))
+
+    def test_shed_primary_suppresses_hedge(self):
+        fired, won, wasted, suppressed = c = self._counters()
+        hedge_ran = threading.Event()
+        with Hedger(HedgePolicy(delay_s=0.01), counters=c) as h:
+            with pytest.raises(_Shed):
+                h.call(
+                    lambda: (_ for _ in ()).throw(_Shed("shed")),
+                    hedge=lambda: hedge_ran.set() or True,
+                )
+        assert suppressed.n == 1 and fired.n == 0
+        assert not hedge_ran.wait(0.05)  # the duplicate never launched
+
+    def test_slow_primary_still_hedges(self):
+        fired, won, wasted, suppressed = c = self._counters()
+        release = threading.Event()
+        with Hedger(HedgePolicy(delay_s=0.01), counters=c) as h:
+            out = h.call(lambda: release.wait(5) and "slow", hedge=lambda: "fast")
+            release.set()
+        assert out.result == "fast" and out.hedged
+        assert fired.n == 1 and suppressed.n == 0
+
+    def test_suppressed_counter_registered(self):
+        from keto_tpu.telemetry.metrics import hedge_counters
+
+        reg = MetricsRegistry()
+        counters = hedge_counters(reg)
+        assert len(counters) == 4
+        counters[3].inc()
+        assert "keto_hedge_suppressed_overload_total 1" in reg.expose()
+
+
+# -- the controller facade ----------------------------------------------------
+
+
+def _controller(clk, metrics=None, flight=None, enabled_fn=None):
+    return OverloadController(
+        max_queue=1_000_000,  # backstop out of reach: ladder only
+        limiter=AdaptiveLimiter(
+            initial=100, target_delay_s=0.05, interval_s=0.05, clock=clk
+        ),
+        brownout=BrownoutController(
+            hysteresis_s=0.5, min_dwell_s=0.02, flight=flight, clock=clk
+        ),
+        throttle=AdaptiveThrottle(window_s=5.0, clock=clk),
+        metrics=metrics,
+        flight=flight,
+        enabled_fn=enabled_fn,
+        clock=clk,
+        rand=lambda: 0.5,
+    )
+
+
+def _storm(ctl, clk, ticks=60, delay=1.0):
+    """Drive sustained over-target latency + admissions at every class."""
+    shed = {CRITICAL: 0, DEFAULT: 0, SHEDDABLE: 0}
+    for _ in range(ticks):
+        clk.advance(0.03)
+        ctl.observe(delay)
+        for crit in (CRITICAL, DEFAULT, SHEDDABLE):
+            if ctl.admit(5000, crit) is not None:
+                shed[crit] += 1
+    return shed
+
+
+class TestOverloadController:
+    def test_storm_sheds_ordered_never_critical(self):
+        clk = _Clock()
+        flight = FlightRecorder(capacity=256, clock=clk)
+        ctl = _controller(clk, flight=flight)
+        shed = _storm(ctl, clk)
+        assert ctl.state() == STATE_SHED_DEFAULT
+        assert shed[CRITICAL] == 0
+        assert shed[SHEDDABLE] > shed[DEFAULT] > 0
+        snap = ctl.snapshot()
+        assert snap["sheds_by_class"][CRITICAL] == 0
+        assert snap["state_name"] == "shed_default"
+
+    def test_recovery_steps_down_within_hysteresis_windows(self):
+        clk = _Clock()
+        ctl = _controller(clk)
+        _storm(ctl, clk)
+        assert ctl.state() >= STATE_SHED_SHEDDABLE
+        # healthy traffic: one rung down per 0.5s hysteresis window
+        for _ in range(200):
+            clk.advance(0.03)
+            ctl.observe(0.001)
+            ctl.admit(0, DEFAULT)
+        assert ctl.state() == STATE_NORMAL
+        # and everything is admitted again
+        assert ctl.admit(0, SHEDDABLE) is None
+
+    def test_disabled_means_admit_everything(self):
+        clk = _Clock()
+        enabled = [False]
+        ctl = _controller(clk, enabled_fn=lambda: enabled[0])
+        shed = _storm(ctl, clk)
+        assert shed == {CRITICAL: 0, DEFAULT: 0, SHEDDABLE: 0}
+        assert ctl.state() == STATE_NORMAL
+        assert ctl.snapshot()["enabled"] is False
+        # the kill switch is live: flipping it on engages the plane
+        enabled[0] = True
+        shed = _storm(ctl, clk)
+        assert shed[SHEDDABLE] > 0
+
+    def test_metrics_families_registered_and_counting(self):
+        clk = _Clock()
+        reg = MetricsRegistry()
+        ctl = _controller(clk, metrics=reg)
+        _storm(ctl, clk)
+        text = reg.expose()
+        for fam in (
+            "keto_overload_state",
+            "keto_overload_limit",
+            "keto_overload_sheds_total",
+            "keto_overload_transitions_total",
+        ):
+            assert fam in text, fam
+        assert 'keto_overload_sheds_total{criticality="sheddable"}' in text
+        assert 'keto_overload_transitions_total{direction="up"}' in text
+
+    def test_flight_records_every_transition(self):
+        clk = _Clock()
+        flight = FlightRecorder(capacity=256, clock=clk)
+        ctl = _controller(clk, flight=flight)
+        _storm(ctl, clk)
+        for _ in range(200):
+            clk.advance(0.03)
+            ctl.observe(0.001)
+            ctl.admit(0, DEFAULT)
+        evs = [r for r in flight.records() if r.get("kind") == "overload"]
+        dirs = {e["direction"] for e in evs}
+        assert dirs == {"up", "down"}
+        assert len(evs) == len(ctl.history())
+
+
+# -- batcher integration ------------------------------------------------------
+
+
+class _GateEngine:
+    """batch_check blocks until released; records dispatch order."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.batches: list = []
+
+    def batch_check(self, requests, depths=None):
+        self.release.wait(10)
+        self.batches.append([r.object for r in requests])
+        return [True] * len(requests)
+
+
+class _StubOverload:
+    """Degradation-query stub: admits everything, culls/LIFO on demand."""
+
+    def __init__(self, cull=None, use_lifo=False):
+        self.cull = cull
+        self.use_lifo = use_lifo
+        self.culled = 0
+
+    def admit(self, queue_len, criticality=DEFAULT):
+        return None
+
+    def observe(self, queue_delay_s, service_s=0.0):
+        pass
+
+    def lifo(self):
+        return self.use_lifo
+
+    def cull_age_s(self):
+        return self.cull
+
+    def note_culled(self, n):
+        self.culled += n
+
+    def stale_ok(self):
+        return False
+
+    def snapshot(self):
+        return {}
+
+
+class TestBatcherIntegration:
+    def _spin(self, batcher, i, crit, results):
+        def run():
+            try:
+                results[i] = batcher.check(
+                    _tup(i), timeout=10, criticality=crit
+                )
+            except BaseException as e:
+                results[i] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return t
+
+    def test_codel_cull_exempts_critical(self):
+        from keto_tpu.engine.batcher import CheckBatcher
+
+        ov = _StubOverload(cull=0.01)
+        eng = _GateEngine()
+        b = CheckBatcher(eng, max_batch=8, window_s=0.0, overload=ov)
+        results: dict = {}
+        try:
+            # occupy the dispatcher inside the (blocked) engine call
+            warm = self._spin(b, 0, DEFAULT, results)
+            time.sleep(0.05)
+            t1 = self._spin(b, 1, CRITICAL, results)
+            t2 = self._spin(b, 2, SHEDDABLE, results)
+            time.sleep(0.1)  # both queued well past the 10ms cull age
+            eng.release.set()
+            for t in (warm, t1, t2):
+                t.join(timeout=10)
+            # the sheddable entry was culled with the typed 429 ...
+            assert isinstance(results[2], ErrResourceExhausted)
+            assert "culled" in str(results[2])
+            # ... while the critical one, just as aged, was served: only
+            # the max_queue backstop may ever fail critical work
+            assert results[1] is True
+            assert ov.culled == 1
+        finally:
+            eng.release.set()
+            b.close()
+
+    def test_adaptive_lifo_serves_newest_first(self):
+        from keto_tpu.engine.batcher import CheckBatcher
+
+        ov = _StubOverload(use_lifo=True)
+        eng = _GateEngine()
+        b = CheckBatcher(eng, max_batch=1, window_s=0.0, overload=ov)
+        results: dict = {}
+        try:
+            warm = self._spin(b, 0, DEFAULT, results)
+            time.sleep(0.05)
+            threads = []
+            for i in (1, 2, 3):
+                threads.append(self._spin(b, i, DEFAULT, results))
+                time.sleep(0.02)  # strictly ordered enqueue times
+            eng.release.set()
+            for t in [warm] + threads:
+                t.join(timeout=10)
+            # max_batch=1: after the warm batch, dispatch order is the
+            # REVERSE of arrival — newest entries still meet deadlines
+            assert eng.batches[1:] == [["o3"], ["o2"], ["o1"]]
+        finally:
+            eng.release.set()
+            b.close()
+
+    def test_criticality_threaded_into_admission(self):
+        from keto_tpu.engine.batcher import CheckBatcher
+
+        seen = []
+
+        class _Recorder(_StubOverload):
+            def admit(self, queue_len, criticality=DEFAULT):
+                seen.append(criticality)
+                return None
+
+        eng = _GateEngine()
+        eng.release.set()
+        b = CheckBatcher(eng, max_batch=8, window_s=0.0, overload=_Recorder())
+        try:
+            b.check(_tup(), timeout=10, criticality=SHEDDABLE)
+            b.check_batch([_tup()], timeout=10, criticality=CRITICAL)
+        finally:
+            b.close()
+        assert seen == [SHEDDABLE, CRITICAL]
+
+    def test_shed_raises_typed_429_with_reason(self):
+        from keto_tpu.engine.batcher import CheckBatcher
+
+        class _Shedder(_StubOverload):
+            def admit(self, queue_len, criticality=DEFAULT):
+                return "brownout"
+
+        eng = _GateEngine()
+        eng.release.set()
+        b = CheckBatcher(eng, max_batch=8, window_s=0.0, overload=_Shedder())
+        try:
+            with pytest.raises(ErrResourceExhausted) as ei:
+                b.check(_tup(), timeout=10, criticality=SHEDDABLE)
+            assert "brownout" in str(ei.value)
+            assert ei.value.status_code == 429
+        finally:
+            b.close()
+
+
+# -- wire plumbing ------------------------------------------------------------
+
+
+class TestWirePlumbing:
+    def test_rest_retry_after_rounds_up_never_zero(self):
+        from keto_tpu.api.rest import _json_error
+
+        err = ErrResourceExhausted("overloaded")
+        err.retry_after_s = 0.2
+        # sub-second hints round UP: "Retry-After: 0" invites the
+        # immediate re-arrival the header exists to prevent
+        assert _json_error(err).headers["Retry-After"] == "1"
+        err.retry_after_s = 1.5
+        assert _json_error(err).headers["Retry-After"] == "2"
+        err.retry_after_s = None
+        assert _json_error(err).headers["Retry-After"] == "1"
+
+    def test_grpc_metadata_criticality(self):
+        from keto_tpu.api.services import (
+            CRITICALITY_METADATA_KEY,
+            _criticality_from_metadata,
+        )
+
+        class _Ctx:
+            def __init__(self, md):
+                self._md = md
+
+            def invocation_metadata(self):
+                return self._md
+
+        assert (
+            _criticality_from_metadata(
+                _Ctx(((CRITICALITY_METADATA_KEY, "sheddable"),))
+            )
+            == SHEDDABLE
+        )
+        assert _criticality_from_metadata(_Ctx(())) == DEFAULT
+        assert (
+            _criticality_from_metadata(_Ctx(()), default=SHEDDABLE)
+            == SHEDDABLE
+        )
+        assert (
+            _criticality_from_metadata(
+                _Ctx(((CRITICALITY_METADATA_KEY, "bogus"),))
+            )
+            == DEFAULT
+        )
+
+    def test_registry_default_criticality_from_config(self):
+        from keto_tpu.driver.registry import Registry
+
+        reg = Registry(
+            Config(
+                values={
+                    "namespaces": [{"id": 1, "name": "n"}],
+                    "overload": {"default_criticality": "sheddable"},
+                },
+                env={},
+            )
+        )
+        assert reg.default_criticality() == SHEDDABLE
+
+
+# -- config surface -----------------------------------------------------------
+
+
+class TestConfigSurface:
+    def test_defaults_present_and_off_by_default(self):
+        assert DEFAULTS["overload.enabled"] is False
+        for key in (
+            "overload.target_delay_ms",
+            "overload.interval_ms",
+            "overload.min_limit",
+            "overload.hysteresis_ms",
+            "overload.dwell_ms",
+            "overload.throttle_window_s",
+            "overload.throttle_k",
+            "overload.default_criticality",
+        ):
+            assert key in DEFAULTS, key
+
+    def test_schema_gates_default_criticality(self):
+        props = CONFIG_SCHEMA["properties"]["overload"]["properties"]
+        # a blanket "critical" default would defeat the ladder entirely
+        assert props["default_criticality"]["enum"] == [
+            "default",
+            "sheddable",
+        ]
+        assert props["enabled"]["type"] == "boolean"
+
+    def test_config_reads_overload_keys(self):
+        cfg = Config(values={}, env={})
+        assert cfg.get("overload.enabled", default=False) is False
+        cfg2 = Config(values={"overload": {"enabled": True}}, env={})
+        assert cfg2.get("overload.enabled", default=False) is True
+
+
+# -- serving surfaces (live server) -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def overload_server():
+    from tests.test_api_server import ServerFixture
+
+    cfg = Config(
+        values={
+            "namespaces": [{"id": 1, "name": "n"}],
+            "log": {"level": "error"},
+            "serve": {
+                "read": {"port": 0, "host": "127.0.0.1"},
+                "write": {"port": 0, "host": "127.0.0.1"},
+            },
+            # generous targets: the plane is ON but must stay at state 0
+            # under this test's trickle of traffic
+            "overload": {"enabled": True, "target_delay_ms": 5000.0},
+        },
+        env={},
+    )
+    s = ServerFixture(cfg)
+    yield s
+    s.stop()
+
+
+class TestServingSurfaces:
+    def test_debug_overload_snapshot(self, overload_server):
+        base = f"http://127.0.0.1:{overload_server.read_port}"
+        with httpx.Client(base_url=base, timeout=60) as c:
+            # 403 = answered "not allowed" (no tuples written) — the
+            # check went through the full admission path either way
+            assert c.get("/check", params={
+                "namespace": "n", "object": "o", "relation": "view",
+                "subject_id": "u",
+            }).status_code in (200, 403)
+            doc = c.get("/debug/overload").json()
+            assert doc["enabled"] is True
+            assert doc["state"] == 0 and doc["state_name"] == "normal"
+            assert doc["limiter"]["limit"] > 0
+            assert doc["brownout"]["ladder"][3] == "shed_sheddable"
+            assert doc["sheds_by_class"][CRITICAL] == 0
+            assert isinstance(doc["history"], list)
+            # the overload families are live on /metrics
+            text = c.get("/metrics").text
+            assert "keto_overload_state 0" in text
+            assert "keto_overload_limit" in text
+
+    def test_rest_criticality_header_round_trip(self, overload_server):
+        checker = overload_server.registry.checker()
+        seen = []
+        orig = checker.check
+
+        def spy(request, *a, **kw):
+            seen.append(kw.get("criticality"))
+            return orig(request, *a, **kw)
+
+        checker.check = spy
+        base = f"http://127.0.0.1:{overload_server.read_port}"
+        try:
+            with httpx.Client(base_url=base, timeout=60) as c:
+                params = {
+                    "namespace": "n", "object": "o", "relation": "view",
+                    "subject_id": "u",
+                }
+                c.get("/check", params=params,
+                      headers={"X-Request-Criticality": "sheddable"})
+                c.get("/check", params=params,
+                      headers={"X-Request-Criticality": "CRITICAL"})
+                c.get("/check", params=params,
+                      headers={"X-Request-Criticality": "bogus"})
+                c.get("/check", params=params)
+        finally:
+            checker.check = orig
+        assert seen == [SHEDDABLE, CRITICAL, DEFAULT, DEFAULT]
+
+    def test_debug_autotune_reports_hedge_suppression(self, overload_server):
+        base = f"http://127.0.0.1:{overload_server.read_port}"
+        with httpx.Client(base_url=base, timeout=60) as c:
+            doc = c.get("/debug/autotune").json()
+            # state 0: hedges advertised as usual
+            assert doc["hedge_suppressed"] is False
+        # force the ladder onto rung 1+: the advertisement must vanish
+        ctl = overload_server.registry._overload
+        assert ctl is not None
+        ctl.brownout.state = STATE_HEDGE_SUPPRESS
+        ctl.brownout._last_update = time.monotonic() + 3600  # pin: no decay
+        try:
+            with httpx.Client(base_url=base, timeout=60) as c:
+                doc = c.get("/debug/autotune").json()
+                assert doc["hedge_suppressed"] is True
+                knobs = doc.get("knobs") or {}
+                if "hedge_delay_ms" in knobs:
+                    assert knobs["hedge_delay_ms"]["value"] is None
+        finally:
+            ctl.brownout.state = STATE_NORMAL
+            ctl.brownout._last_update = None

@@ -220,8 +220,9 @@ class TestHedgedReads:
         assert out.result is True
         assert out.hedged is True and out.hedge_won is True
         assert out.elapsed_s < 0.35  # the 400ms stall never reached p99
-        fired, won, wasted = counters
+        fired, won, wasted, suppressed = counters
         assert (fired.value, won.value, wasted.value) == (1, 1, 0)
+        assert suppressed.value == 0
 
     def test_fast_primary_never_hedges(self):
         from keto_tpu.client import HedgePolicy, Hedger
@@ -238,7 +239,7 @@ class TestHedgedReads:
         assert out.result == 7
         assert out.hedged is False
         assert calls == ["primary"]
-        assert [c.value for c in counters] == [0, 0, 0]
+        assert [c.value for c in counters] == [0, 0, 0, 0]
 
     def test_at_most_one_hedge_and_loser_discarded(self):
         from keto_tpu.client import HedgePolicy, Hedger
@@ -263,8 +264,9 @@ class TestHedgedReads:
             release.set()
         assert out.result == "fresh"  # the duplicate's answer was used,
         assert started == ["primary", "hedge"]  # and issued exactly once
-        fired, won, wasted = counters
+        fired, won, wasted, suppressed = counters
         assert (fired.value, won.value, wasted.value) == (1, 1, 0)
+        assert suppressed.value == 0
 
     def test_primary_win_after_hedge_counts_wasted(self):
         from keto_tpu.client import HedgePolicy, Hedger
@@ -287,8 +289,9 @@ class TestHedgedReads:
             release.set()
         assert out.result == "primary"
         assert out.hedged is True and out.hedge_won is False
-        fired, won, wasted = counters
+        fired, won, wasted, suppressed = counters
         assert (fired.value, won.value, wasted.value) == (1, 0, 1)
+        assert suppressed.value == 0
 
 
 class TestEndpointRouter:

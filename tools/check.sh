@@ -27,7 +27,11 @@
 #      failover with bounded recovery), and the game-day election drill
 #      (--election: SIGKILL the elected leader mid-traffic; a follower
 #      must win the lease within 2x TTL with zero acked-write loss,
-#      reads never stop, exactly one fencing-token lineage)
+#      reads never stop, exactly one fencing-token lineage), and the
+#      overload drill (--overload: offer ~10x measured capacity
+#      open-loop with a criticality mix; goodput >= 0.8x capacity,
+#      zero critical sheds, sheddable shed before default, retry
+#      amplification <= 1.1x, brownout ladder steps back to normal)
 #   5. replication gate — 1 leader + 2 followers in-process: checkpoint
 #      bootstrap + WAL-tail convergence under a lag bound, token-
 #      consistent reads on followers (wait AND bounce paths), read-only
@@ -66,7 +70,14 @@
 #      against a real engine/WAL/follower, detected within the cycle
 #      budget, auto-repaired, and the post-repair state byte-identical
 #      to the host truth (oracle answers / cold recovery / leader set)
-#  11. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
+#  11. overload gate — tools/overload_gate.py: the overload-control
+#      plane against a scripted 10x open-loop burst: goodput >= 0.8x
+#      of capacity, sheds strictly sheddable-before-default and never
+#      critical, accepted latency bounded by the CoDel/LIFO discipline,
+#      the brownout ladder steps back to normal after the burst, every
+#      transition lands in the flight recorder, and a RetryBudget caps
+#      retry amplification at 1.1x under total shed
+#  12. tier-1 tests — the ROADMAP.md tier-1 command, verbatim
 #
 # Usage: bash tools/check.sh            (from the repo root)
 set -o pipefail
@@ -97,7 +108,7 @@ echo "== bench smoke =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python bench.py --smoke || exit 1
 
 echo "== chaos soak smoke =="
-timeout -k 10 330 env JAX_PLATFORMS=cpu python tools/soak.py --smoke --seed 4 --pool --restart --device-chaos --election || exit 1
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/soak.py --smoke --seed 4 --pool --restart --device-chaos --election --overload || exit 1
 
 echo "== replication gate =="
 timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/replication_gate.py || exit 1
@@ -131,6 +142,11 @@ echo "== scrub gate =="
 # post-repair state (engine vs oracle, cold recovery vs live store,
 # follower vs leader)
 timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/scrub_gate.py || exit 1
+
+echo "== overload gate =="
+# the overload-control plane, seeded + deterministic: goodput floor at
+# 10x, strict criticality shed ordering, ladder recovery, retry budget
+timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/overload_gate.py || exit 1
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

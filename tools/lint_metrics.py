@@ -214,6 +214,10 @@ def _run_live_gate() -> list[str]:
             # scrubber on (same long-interval trick: registers the
             # keto_scrub_* families without scrubbing mid-scrape)
             "scrub": {"enabled": True, "interval_s": 600.0},
+            # overload controller on: registers the keto_overload_*
+            # families (it only sheds under pressure, so the lint
+            # traffic is unaffected)
+            "overload": {"enabled": True},
         },
         env={},
     )

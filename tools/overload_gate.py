@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Overload gate (tools/check.sh): the overload-control plane against a
+scripted open-loop world with a KNOWN capacity.
+
+The in-process OverloadController fronts a deterministic queueing system
+(fixed service capacity, fake clock — no sleeps, no server boot) driven
+through three phases: a 1x warmup at capacity, a sustained 10x open-loop
+burst, and a 1x recovery. The gate proves the serving invariants the
+plane exists for:
+
+- **goodput floor**: during the 10x burst the served rate stays >= 0.8x
+  of measured capacity — admission control sheds the excess instead of
+  letting a standing queue destroy everyone's latency;
+- **strict shed ordering**: ``sheddable`` sheds strictly before the
+  first ``default`` shed, and ``critical`` is NEVER shed (the static
+  max_queue backstop is sized out of reach here, so any critical shed
+  is a ladder bug);
+- **bounded accepted latency**: requests the plane admits AND serves
+  complete within a small multiple of the standing-queue target — the
+  CoDel cull + adaptive LIFO keep accepted work fresh instead of
+  serving a minutes-deep queue in order;
+- **ladder recovery**: after the burst ends, keto_overload_state steps
+  back down to normal within the hysteresis windows (one per rung) —
+  no latched brownout;
+- **retry discipline**: shed clients retrying through a RetryBudget
+  amplify offered load by <= 1.1x (burst tokens excluded), not by
+  max_attempts x;
+- **evidence**: every ladder transition is a flight-recorder event
+  (kind=overload) and the keto_overload_* metric families are present.
+
+Exit 0 = all invariants hold; exit 1 with a reason otherwise.
+Sub-second runtime: the cheap always-on CI proof that brownout logic
+degrades in priority order and un-degrades when load drops.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from keto_tpu.client.retry import (  # noqa: E402
+    RetryBudget,
+    RetryPolicy,
+    run_with_retry,
+)
+from keto_tpu.engine.overload import (  # noqa: E402
+    CRITICAL,
+    DEFAULT,
+    SHEDDABLE,
+    AdaptiveLimiter,
+    AdaptiveThrottle,
+    BrownoutController,
+    OverloadController,
+)
+from keto_tpu.telemetry import MetricsRegistry  # noqa: E402
+from keto_tpu.telemetry.flight import FlightRecorder  # noqa: E402
+from keto_tpu.utils.errors import ErrResourceExhausted  # noqa: E402
+
+
+def fail(msg: str) -> None:
+    print(f"overload gate: FAIL: {msg}")
+    sys.exit(1)
+
+
+class World:
+    """Deterministic open-loop queueing system: ``capacity`` requests
+    served per simulated second, arrivals offered tick-by-tick at a
+    criticality mix of 20% critical / 60% default / 20% sheddable."""
+
+    TICK_S = 0.01
+
+    def __init__(self, controller: OverloadController, capacity: float):
+        self.c = controller
+        self.capacity = capacity
+        self.now = 0.0
+        self.queue: list = []  # (t_arrival, criticality)
+        self.served = 0
+        self.culled = 0
+        self.accepted_delays: list = []
+        self.shed_log: list = []  # criticality, in shed order
+
+    def mix(self, i: int) -> str:
+        r = i % 10
+        if r < 2:
+            return CRITICAL
+        if r < 8:
+            return DEFAULT
+        return SHEDDABLE
+
+    def tick(self, offered_rate: float) -> None:
+        self.now += self.TICK_S
+        n_arrivals = int(round(offered_rate * self.TICK_S))
+        for i in range(n_arrivals):
+            crit = self.mix(self.served + len(self.queue) + i)
+            reason = self.c.admit(len(self.queue), crit)
+            if reason is None:
+                self.queue.append((self.now, crit))
+            else:
+                self.shed_log.append(crit)
+        # queue discipline: the controller's CoDel cull + LIFO flip
+        cutoff = self.c.cull_age_s()
+        if cutoff is not None:
+            keep = [e for e in self.queue if self.now - e[0] <= cutoff]
+            n_culled = len(self.queue) - len(keep)
+            if n_culled:
+                self.c.note_culled(n_culled)
+                self.culled += n_culled
+                self.queue = keep
+        budget = int(round(self.capacity * self.TICK_S))
+        if self.c.lifo():
+            batch, self.queue = self.queue[-budget:], self.queue[:-budget]
+        else:
+            batch, self.queue = self.queue[:budget], self.queue[budget:]
+        if batch:
+            delay = self.now - min(t for t, _ in batch)
+            self.c.observe(delay, service_s=self.TICK_S)
+            self.served += len(batch)
+            self.accepted_delays.extend(self.now - t for t, _ in batch)
+        else:
+            self.c.observe(0.0)
+
+
+def main() -> int:
+    capacity = 2000.0  # requests per simulated second
+    world_ref = {}
+    clock = lambda: world_ref["w"].now  # noqa: E731
+    flight = FlightRecorder(capacity=512, clock=clock)
+    metrics = MetricsRegistry()
+    target_s = 0.05
+    controller = OverloadController(
+        max_queue=1_000_000,  # backstop sized out of reach: ladder only
+        limiter=AdaptiveLimiter(
+            initial=200, min_limit=8, max_limit=1_000_000,
+            target_delay_s=target_s, interval_s=0.1, clock=clock,
+        ),
+        brownout=BrownoutController(
+            hysteresis_s=0.5, min_dwell_s=0.05, flight=flight, clock=clock,
+        ),
+        throttle=AdaptiveThrottle(window_s=5.0, clock=clock),
+        metrics=metrics,
+        flight=flight,
+        clock=clock,
+        rand=lambda: 0.5,
+    )
+    world = World(controller, capacity)
+    world_ref["w"] = world
+
+    # -- phase 1: 1x warmup (2 simulated seconds) ----------------------------
+    for _ in range(200):
+        world.tick(capacity)
+    if controller.state() != 0:
+        fail(
+            f"ladder left normal under 1x load "
+            f"(state={controller.snapshot()['state_name']})"
+        )
+    sheds_at_capacity = len(world.shed_log)
+
+    # -- phase 2: 10x open-loop burst (4 simulated seconds) ------------------
+    served_before = world.served
+    burst_ticks = 400
+    for _ in range(burst_ticks):
+        world.tick(10.0 * capacity)
+    burst_goodput = (world.served - served_before) / (
+        burst_ticks * World.TICK_S
+    )
+    snap = controller.snapshot()
+
+    if burst_goodput < 0.8 * capacity:
+        fail(
+            f"goodput under 10x burst was {burst_goodput:.0f}/s, below "
+            f"the 0.8x floor of capacity {capacity:.0f}/s"
+        )
+    sheds = snap["sheds_by_class"]
+    if sheds[CRITICAL] != 0:
+        fail(f"{sheds[CRITICAL]} critical requests shed — ladder must "
+             "never shed critical before the hard backstop")
+    if sheds[SHEDDABLE] == 0:
+        fail("a 10x burst shed nothing sheddable — admission is dead")
+    burst_sheds = world.shed_log[sheds_at_capacity:]
+    if DEFAULT in burst_sheds:
+        first_default = burst_sheds.index(DEFAULT)
+        if SHEDDABLE not in burst_sheds[:first_default]:
+            fail("a default-class request was shed before any "
+                 "sheddable-class request — brownout ordering violated")
+    if snap["state"] < 3:
+        fail(
+            f"10x burst never climbed the ladder to shed_sheddable "
+            f"(state={snap['state_name']})"
+        )
+
+    # accepted-work latency stays bounded: CoDel cull + LIFO mean admitted
+    # requests are served fresh, not after a minutes-deep queue drains
+    worst_accepted = max(world.accepted_delays)
+    if worst_accepted > 20 * target_s:
+        fail(
+            f"an admitted request waited {worst_accepted * 1e3:.0f}ms, "
+            f"over 20x the {target_s * 1e3:.0f}ms standing-queue target "
+            "— the cull/LIFO discipline is not bounding accepted latency"
+        )
+
+    # -- phase 3: 1x recovery — ladder must step back down -------------------
+    # one hysteresis window per rung (+1 slack for the dwell)
+    recovery_ticks = int((snap["state"] + 1) * 0.5 / World.TICK_S) + 100
+    for _ in range(recovery_ticks):
+        world.tick(capacity)
+    if controller.state() != 0:
+        fail(
+            f"ladder did not return to normal within "
+            f"{recovery_ticks * World.TICK_S:.1f}s of the burst ending "
+            f"(state={controller.snapshot()['state_name']})"
+        )
+
+    # -- evidence: flight transitions + metric families ----------------------
+    kinds = [r for r in flight.records() if r.get("kind") == "overload"]
+    if not kinds:
+        fail("no kind=overload flight records — transitions are invisible")
+    directions = {r.get("direction") for r in kinds}
+    if not {"up", "down"} <= directions:
+        fail(f"flight records cover directions {directions}, need both "
+             "up and down")
+    text = metrics.expose()
+    for family in (
+        "keto_overload_state",
+        "keto_overload_limit",
+        "keto_overload_sheds_total",
+        "keto_overload_transitions_total",
+    ):
+        if family not in text:
+            fail(f"metric family {family} missing from exposition")
+
+    # -- retry discipline: budget caps amplification at ~1.1x ----------------
+    budget = RetryBudget(ratio=0.1, burst=10.0)
+    policy = RetryPolicy(
+        max_attempts=4, base_delay_s=0.0, max_delay_s=0.0,
+        sleep=lambda _s: None, rand=lambda: 0.0,
+    )
+    attempts = [0]
+
+    def always_shed(_remaining):
+        attempts[0] += 1
+        raise ErrResourceExhausted("scripted shed")
+
+    n_requests = 2000
+    for _ in range(n_requests):
+        try:
+            run_with_retry(
+                always_shed, policy,
+                retryable=lambda e: isinstance(e, ErrResourceExhausted),
+                budget=budget,
+            )
+        except ErrResourceExhausted:
+            pass
+    amplification = (attempts[0] - budget.burst) / n_requests
+    if amplification > 1.1:
+        fail(
+            f"retry amplification under total shed was "
+            f"{amplification:.3f}x, over the 1.1x budget ceiling"
+        )
+
+    print(
+        f"overload gate: OK — goodput {burst_goodput:.0f}/s "
+        f"(>= 0.8x of {capacity:.0f}/s) at 10x, sheds "
+        f"crit/def/shed={sheds[CRITICAL]}/{sheds[DEFAULT]}/"
+        f"{sheds[SHEDDABLE]} in priority order, worst accepted delay "
+        f"{worst_accepted * 1e3:.0f}ms, ladder recovered to normal, "
+        f"{len(kinds)} flight transitions, retry amplification "
+        f"{amplification:.3f}x"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -664,6 +664,316 @@ def run_engine_soak(
     return summary
 
 
+def run_overload_drill(seed: int, smoke: bool = True) -> dict:
+    """The 10x open-loop overload drill (--overload): boot the serving
+    stack with the overload-control plane on, measure this process's
+    closed-loop check capacity, then offer ~10x that rate open-loop with
+    a 20/60/20 critical/default/sheddable mix, every shed retried
+    through a shared client RetryBudget. Invariants:
+
+    - goodput (served accepted checks/s) during the burst >= 0.8x the
+      measured capacity — admission control keeps the engine busy on
+      work it finishes instead of queueing everything;
+    - zero critical-class sheds; the first default-class shed never
+      precedes the first sheddable-class shed;
+    - accepted checks keep a bounded p99 (the CoDel cull + LIFO flip
+      serve admitted work fresh);
+    - client retry amplification (attempts/requests) <= 1.1x;
+    - the brownout ladder is visibly engaged during the burst (state >=
+      shed_sheddable, flight kind=overload transitions recorded) and
+      steps back to normal within the hysteresis windows after the
+      offered load drops to 1x.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from keto_tpu.client.retry import RetryBudget
+    from keto_tpu.engine.overload import CRITICAL, DEFAULT, SHEDDABLE
+    from keto_tpu.utils.errors import ErrResourceExhausted
+
+    FAULTS.reset()
+    hysteresis_s = 0.4
+    cfg = Config(
+        values={
+            "namespaces": [{"id": 1, "name": "n"}],
+            "log": {"level": "error"},
+            "engine": {
+                "mode": "device",
+                # a deliberately window-bound engine (~max_batch/window
+                # checks/s) so a thread-pool client can genuinely offer
+                # 10x its capacity — the pool of blocking workers tops
+                # out near 4k submits/s on a small box, so the engine
+                # must serve well under that for real pressure to build;
+                # max_queue is sized out of reach so every shed in this
+                # drill is the LADDER's decision, not the static
+                # backstop's
+                "max_batch": 8,
+                "batch_window_us": 8000,
+                "max_queue": 100000,
+                "cache_size": 0,  # cache hits would fake infinite capacity
+                "encoded_cache_size": 0,
+            },
+            "overload": {
+                "enabled": True,
+                "target_delay_ms": 50.0,
+                "interval_ms": 50.0,
+                "hysteresis_ms": hysteresis_s * 1e3,
+                "dwell_ms": 25.0,
+                "throttle_window_s": 5.0,
+            },
+        }
+    )
+    reg = Registry(cfg)
+    store = reg.store()
+    objs = [f"o{i}" for i in range(64)]
+    store.transact_relation_tuples([_tup(o) for o in objs], [])
+    checker = reg.checker()
+    controller = reg.overload()
+    violations = _Violations()
+    rng = random.Random(seed)
+
+    def crit_for(i: int) -> str:
+        # 8/62/30 critical/default/sheddable: the critical slice of a
+        # 10x burst (0.8x capacity) must fit under capacity alone, or no
+        # admission policy could serve it all without shedding critical
+        r = i % 50
+        return CRITICAL if r < 4 else (DEFAULT if r < 35 else SHEDDABLE)
+
+    budget = RetryBudget(ratio=0.1)
+    attempts = [0]
+    stats_lock = threading.Lock()
+    # per-class: [accepted, shed, culled]; plus first-shed timestamps
+    # (admission sheds only) for the ordering invariant
+    by_class = {c: [0, 0, 0] for c in (CRITICAL, DEFAULT, SHEDDABLE)}
+    first_shed: dict = {}
+    accepted_lat: list[float] = []
+    last_accept = [0.0]
+
+    def one_check(i: int, crit: str, retry: bool) -> None:
+        """One client request: check, and on a shed spend the shared
+        retry budget for exactly one immediate retry."""
+        budget.on_request()
+        for attempt in (0, 1):
+            t0 = time.perf_counter()
+            with stats_lock:
+                attempts[0] += 1
+            try:
+                checker.check(
+                    _tup(objs[i % len(objs)]),
+                    timeout=PER_OP_TIMEOUT_S,
+                    criticality=crit,
+                )
+            except ErrResourceExhausted as e:
+                # the CoDel cull (queued work dropped for aging past the
+                # delay target) is latency protection, not an admission
+                # decision — keep it out of the shed-ordering accounting
+                is_cull = "culled" in str(e)
+                with stats_lock:
+                    if is_cull:
+                        by_class[crit][2] += 1
+                    else:
+                        by_class[crit][1] += 1
+                        first_shed.setdefault(crit, time.perf_counter())
+                if retry and attempt == 0 and budget.spend():
+                    continue
+                return
+            except KetoError:
+                return  # typed transient: not this drill's concern
+            except Exception as e:  # noqa: BLE001
+                violations.add(f"untyped error from check: {e!r}")
+                return
+            with stats_lock:
+                by_class[crit][0] += 1
+                accepted_lat.append(time.perf_counter() - t0)
+                last_accept[0] = time.perf_counter()
+            return
+
+    # -- phase 1: closed-loop capacity measurement ---------------------------
+    # capacity is a supremum: a scheduler stall can only DEPRESS a
+    # closed-loop window, never inflate it, so the max over two windows
+    # is the robust estimate — an under-measured capacity would make
+    # the "10x" burst not actually exceed the engine and the ladder
+    # (correctly) never engage
+    warm_s = 1.0 if smoke else 2.0
+    n_workers = 16
+    counted = [0]
+    t_end = [0.0]
+
+    def closed_loop(idx: int) -> None:
+        i = idx
+        while time.perf_counter() < t_end[0]:
+            one_check(i, DEFAULT, retry=False)
+            i += n_workers
+            with stats_lock:
+                counted[0] += 1
+
+    capacity = 0.0
+    for _ in range(2):
+        with stats_lock:
+            counted[0] = 0
+        t_end[0] = time.perf_counter() + warm_s
+        with ThreadPoolExecutor(max_workers=n_workers) as pool:
+            list(pool.map(closed_loop, range(n_workers)))
+        capacity = max(capacity, counted[0] / warm_s)
+    if capacity <= 0:
+        violations.add("capacity measurement served nothing")
+        capacity = 1.0
+    # reset the per-class accounting: only the burst is asserted on
+    with stats_lock:
+        for c in by_class:
+            by_class[c] = [0, 0, 0]
+        first_shed.clear()
+        accepted_lat.clear()
+        attempts[0] = 0
+
+    # -- phase 2: 10x open-loop burst ----------------------------------------
+    # offered rate is fixed at 10x capacity regardless of completions
+    # (open loop); total submissions bounded so CI wall time stays sane
+    burst_s = min(3.0 if smoke else 6.0, 30000.0 / (10.0 * capacity))
+    offered_rate = 10.0 * capacity
+    n_offered = int(offered_rate * burst_s)
+    requests_made = [0]
+    t_burst0 = time.perf_counter()
+    pool = ThreadPoolExecutor(max_workers=128)
+    try:
+        tick_s = 0.005
+        i = 0
+        # the ladder can step back down before the last submission
+        # lands (hysteresis is short by design here), so the "did the
+        # shed rungs engage" check needs the max state seen across the
+        # burst, not a point sample at the end
+        burst_state = 0
+        while i < n_offered:
+            tick_deadline = time.perf_counter() + tick_s
+            target = min(
+                n_offered,
+                int((time.perf_counter() - t_burst0) * offered_rate)
+                + int(offered_rate * tick_s),
+            )
+            while i < target:
+                pool.submit(one_check, rng.randrange(1 << 20),
+                            crit_for(i), True)
+                requests_made[0] += 1
+                i += 1
+            burst_state = max(burst_state, controller.state())
+            now = time.perf_counter()
+            if now < tick_deadline:
+                time.sleep(tick_deadline - now)
+        burst_state = max(burst_state, controller.state())
+        pool.shutdown(wait=True)
+    finally:
+        pool.shutdown(wait=True)
+    burst_wall = time.perf_counter() - t_burst0
+    # goodput is measured to the LAST acceptance, not the full drain:
+    # once the submitter stops, what remains in the pool is mostly the
+    # shed/retry path (fast rejections against a dry budget) — wall
+    # time spent draining it says nothing about how fast admitted work
+    # was served
+    served_wall = (
+        last_accept[0] - t_burst0
+        if last_accept[0] > t_burst0
+        else burst_wall
+    )
+    goodput = sum(v[0] for v in by_class.values()) / max(served_wall, 1e-9)
+    goodput_frac = goodput / capacity
+    amplification = attempts[0] / max(1, requests_made[0])
+
+    # -- phase 3: load drops to ~0 — ladder must step back down --------------
+    recover_deadline = time.perf_counter() + (4 * hysteresis_s + 2.0)
+    recovered_in_s = None
+    t_rec0 = time.perf_counter()
+    while time.perf_counter() < recover_deadline:
+        one_check(rng.randrange(1 << 20), DEFAULT, retry=False)
+        if controller.state() == 0:
+            recovered_in_s = time.perf_counter() - t_rec0
+            break
+        time.sleep(0.02)
+
+    # -- invariants ----------------------------------------------------------
+    if goodput_frac < 0.8:
+        violations.add(
+            f"goodput at 10x was {goodput_frac:.2f}x of capacity "
+            f"({goodput:.0f}/s vs {capacity:.0f}/s), below the 0.8x floor"
+        )
+    if by_class[CRITICAL][1]:
+        violations.add(
+            f"{by_class[CRITICAL][1]} critical-class sheds — the ladder "
+            "must never shed critical"
+        )
+    if by_class[CRITICAL][2]:
+        violations.add(
+            f"{by_class[CRITICAL][2]} critical-class culls — the CoDel "
+            "cull must exempt critical entries"
+        )
+    if not by_class[SHEDDABLE][1]:
+        violations.add("10x burst shed nothing sheddable — admission dead")
+    if DEFAULT in first_shed and SHEDDABLE in first_shed:
+        if first_shed[DEFAULT] < first_shed[SHEDDABLE]:
+            violations.add(
+                "a default-class request was shed before any "
+                "sheddable-class request — brownout ordering violated"
+            )
+    if burst_state < 3:
+        violations.add(
+            f"the burst never engaged the shed rungs (state={burst_state})"
+        )
+    if amplification > 1.1:
+        violations.add(
+            f"retry amplification {amplification:.3f}x over the 1.1x "
+            "budget ceiling"
+        )
+    lat = sorted(accepted_lat)
+    accepted_p99 = _percentile(lat, 0.99)
+    if accepted_p99 > P99_BUDGET_S:
+        violations.add(
+            f"accepted p99 {accepted_p99 * 1e3:.0f}ms over the "
+            f"{P99_BUDGET_S}s budget — admitted work is not being "
+            "served fresh"
+        )
+    if recovered_in_s is None:
+        violations.add(
+            f"ladder did not return to normal within "
+            f"{4 * hysteresis_s + 2.0:.1f}s of the burst ending "
+            f"(state={controller.state()})"
+        )
+    flight_overload = [
+        r for r in reg.flight().records()
+        if r.get("kind") == "overload"
+    ]
+    if not flight_overload:
+        violations.add("no kind=overload flight records from the burst")
+
+    checker.close()
+    snap = controller.snapshot()
+    return {
+        "phase": "overload",
+        "seed": seed,
+        "capacity_per_s": round(capacity, 1),
+        "offered_rate_per_s": round(offered_rate, 1),
+        # the realized rate can trail the 10x attempt when the client
+        # pool itself saturates; still well past capacity, which is what
+        # the invariants need
+        "offered_realized_per_s": round(
+            requests_made[0] / max(burst_wall, 1e-9), 1
+        ),
+        "burst_s": round(burst_wall, 2),
+        "served_wall_s": round(served_wall, 2),
+        "goodput_per_s": round(goodput, 1),
+        "goodput_frac": round(goodput_frac, 3),
+        "burst_state": burst_state,
+        "accepted_by_class": {c: v[0] for c, v in by_class.items()},
+        "shed_by_class": {c: v[1] for c, v in by_class.items()},
+        "culled_by_class": {c: v[2] for c, v in by_class.items()},
+        "retry_amplification": round(amplification, 3),
+        "accepted_p99_ms": round(accepted_p99 * 1e3, 2),
+        "recovered_in_s": (
+            round(recovered_in_s, 2) if recovered_in_s is not None else None
+        ),
+        "flight_transitions": len(flight_overload),
+        "controller": snap,
+        "violations": violations.items,
+    }
+
+
 def run_device_chaos(seed: int) -> dict:
     """--device-chaos: the device-fault & memory-pressure drills.
 
@@ -1724,6 +2034,12 @@ def main(argv=None) -> int:
         "quarantine, device-loss failover)",
     )
     ap.add_argument(
+        "--overload", action="store_true",
+        help="also run the 10x open-loop overload drill (goodput floor, "
+        "strict criticality shed ordering, retry-budget amplification "
+        "cap, brownout ladder recovery)",
+    )
+    ap.add_argument(
         "--election", action="store_true",
         help="also run the game-day failover drill (SIGKILL the elected "
         "leader mid-traffic; assert failover within the lease budget, "
@@ -1761,6 +2077,8 @@ def main(argv=None) -> int:
         phases.append(
             run_election_drill(args.seed, ops=60 if args.smoke else 150)
         )
+    if args.overload:
+        phases.append(run_overload_drill(args.seed, smoke=args.smoke))
     bad = [v for p in phases for v in p["violations"]]
     print(json.dumps({"phases": phases, "ok": not bad}, indent=2))
     if bad:
